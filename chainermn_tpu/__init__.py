@@ -1,0 +1,57 @@
+"""chainermn_tpu — TPU-native distributed training with the ChainerMN
+programming model.
+
+A brand-new JAX/XLA framework providing the capabilities of the reference
+(``anaruse/chainermn``: communicator-based data-parallel training, the
+pure-collective data path, mixed-precision gradient allreduce, the
+double-buffered multi-node optimizer, send/recv model parallelism), rebuilt
+TPU-first: mesh axes instead of MPI ranks, XLA collectives over ICI/DCN
+instead of NCCL/MPI, functional pytrees instead of in-place link mutation.
+
+Import surface mirrors the reference's 〔chainermn/__init__.py〕 facade
+(lazy, PEP 562, so ``import chainermn_tpu`` stays light).
+"""
+
+__version__ = "0.1.0"
+
+# name -> submodule providing it
+_EXPORTS = {
+    "CommunicatorBase": "chainermn_tpu.communicators",
+    "create_communicator": "chainermn_tpu.communicators",
+    "create_multi_node_optimizer": "chainermn_tpu.optimizers",
+    "make_train_step": "chainermn_tpu.optimizers",
+    "scatter_dataset": "chainermn_tpu.datasets",
+    "scatter_index": "chainermn_tpu.datasets",
+    "create_multi_node_evaluator": "chainermn_tpu.extensions",
+    "AllreducePersistent": "chainermn_tpu.extensions",
+    "create_multi_node_checkpointer": "chainermn_tpu.extensions",
+    "create_multi_node_iterator": "chainermn_tpu.iterators",
+    "create_synchronized_iterator": "chainermn_tpu.iterators",
+    "MultiNodeChainList": "chainermn_tpu.links",
+    "init_topology": "chainermn_tpu.parallel.topology",
+    "Topology": "chainermn_tpu.parallel.topology",
+    "DATA_AXES": "chainermn_tpu.parallel.topology",
+    "INTER_AXIS": "chainermn_tpu.parallel.topology",
+    "INTRA_AXIS": "chainermn_tpu.parallel.topology",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        try:
+            mod = importlib.import_module(_EXPORTS[name])
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"chainermn_tpu.{name} is unavailable: {e}") from e
+        val = getattr(mod, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module 'chainermn_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,78 @@
+"""Communicator factory.
+
+Reference (path unverified, SURVEY.md provenance): ``create_communicator`` in
+〔chainermn/communicators/__init__.py〕 — string -> class dispatch over
+``naive``, ``flat``, ``hierarchical`` (default), ``two_dimensional``,
+``single_node``, ``non_cuda_aware``, ``pure_nccl``; only ``pure_nccl``
+accepts ``allreduce_grad_dtype``.
+
+The same names resolve here (so stock scripts run unchanged), plus the
+TPU-native name ``xla`` for the pure-collective data path — ``pure_nccl`` is
+an alias for it, since NCCL's role belongs to XLA/ICI on TPU
+(BASELINE.json:north_star).
+"""
+
+from typing import Optional
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+from chainermn_tpu.communicators.naive_communicator import NaiveCommunicator
+from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
+from chainermn_tpu.communicators.hierarchical_communicator import HierarchicalCommunicator
+from chainermn_tpu.communicators.two_dimensional_communicator import TwoDimensionalCommunicator
+from chainermn_tpu.communicators.single_node_communicator import SingleNodeCommunicator
+from chainermn_tpu.communicators.non_cuda_aware_communicator import NonCudaAwareCommunicator
+from chainermn_tpu.communicators.xla_communicator import XlaCommunicator
+
+_COMMUNICATORS = {
+    "naive": NaiveCommunicator,
+    "flat": FlatCommunicator,
+    "hierarchical": HierarchicalCommunicator,
+    "two_dimensional": TwoDimensionalCommunicator,
+    "single_node": SingleNodeCommunicator,
+    "non_cuda_aware": NonCudaAwareCommunicator,
+    "xla": XlaCommunicator,
+    "pure_nccl": XlaCommunicator,  # reference name -> TPU data plane
+}
+
+
+def create_communicator(
+    communicator_name: str = "hierarchical",
+    mesh=None,
+    allreduce_grad_dtype=None,
+    intra_size: Optional[int] = None,
+    **kwargs,
+) -> CommunicatorBase:
+    """Create a communicator by name (reference signature:
+    ``create_communicator(communicator_name, mpi_comm, allreduce_grad_dtype)``;
+    the ``mpi_comm`` argument becomes ``mesh`` — topology is discovered from
+    the device list when omitted, no launcher in the loop).
+    """
+    try:
+        cls = _COMMUNICATORS[communicator_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown communicator {communicator_name!r}; available: "
+            f"{sorted(_COMMUNICATORS)}") from None
+    if allreduce_grad_dtype is not None and not cls.supports_allreduce_grad_dtype:
+        # Parity with the reference factory's restriction.
+        raise ValueError(
+            "allreduce_grad_dtype is only supported by the 'xla'/'pure_nccl' "
+            "communicator")
+    if allreduce_grad_dtype is not None:
+        kwargs["allreduce_grad_dtype"] = allreduce_grad_dtype
+    return cls(mesh=mesh, intra_size=intra_size, **kwargs)
+
+
+__all__ = [
+    "CommunicatorBase",
+    "MeshCommunicator",
+    "NaiveCommunicator",
+    "FlatCommunicator",
+    "HierarchicalCommunicator",
+    "TwoDimensionalCommunicator",
+    "SingleNodeCommunicator",
+    "NonCudaAwareCommunicator",
+    "XlaCommunicator",
+    "create_communicator",
+]

@@ -1,0 +1,86 @@
+"""Flat-buffer pack / unpack of gradient pytrees.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``pack_params`` / ``unpack_params`` / ``DeviceMemory`` in
+〔chainermn/communicators/_memory_utility.py〕 — gather every ``param.grad``
+into one contiguous GPU buffer by byte offset (with optional dtype cast via a
+runtime-compiled CUDA kernel), run one collective over the buffer, scatter
+back.
+
+TPU-native version: the "buffer" is a flat jnp array built inside the traced
+allreduce; XLA owns the actual memory.  Leaves are grouped by dtype (one flat
+buffer per dtype) unless a communication dtype is forced, in which case a
+single buffer is used and the cast in/out is fused by XLA (or by the Pallas
+cast+scale kernel, see ``chainermn_tpu/ops/cast_scale.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack(tree: Any, comm_dtype: Optional[jnp.dtype] = None):
+    """Flatten a pytree into per-dtype flat buffers.
+
+    Returns ``(buffers, meta)`` where ``buffers`` is a list of 1-D arrays and
+    ``meta`` recovers the tree via :func:`unpack`.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return [], (treedef, [], [])
+    groups: dict = {}
+    order = []  # (group_key, index_within_group, shape, orig_dtype)
+    for leaf in leaves:
+        key = "comm" if comm_dtype is not None else str(leaf.dtype)
+        groups.setdefault(key, [])
+        order.append((key, len(groups[key]), leaf.shape, leaf.dtype))
+        flat = leaf.reshape(-1)
+        if comm_dtype is not None and leaf.dtype != comm_dtype:
+            flat = flat.astype(comm_dtype)
+        groups[key].append(flat)
+    keys = list(groups.keys())
+    buffers = [jnp.concatenate(groups[k]) if len(groups[k]) > 1 else groups[k][0]
+               for k in keys]
+    return buffers, (treedef, keys, order)
+
+
+def unpack(buffers: List[jnp.ndarray], meta, scale: Optional[float] = None):
+    """Inverse of :func:`pack`; optionally fuses a ``*= scale`` (the
+    reference's 1/size multiply, fused with the cast-back kernel)."""
+    treedef, keys, order = meta
+    if not order:
+        return jax.tree.unflatten(treedef, [])
+    if scale is not None:
+        buffers = [b * jnp.asarray(scale, b.dtype) for b in buffers]
+    # Compute split points per group.
+    offsets = {k: [0] for k in keys}
+    sizes: dict = {k: [] for k in keys}
+    for key, _, shape, _ in order:
+        n = int(np.prod(shape)) if shape else 1
+        sizes[key].append(n)
+        offsets[key].append(offsets[key][-1] + n)
+    pieces_by_group = {}
+    for k, buf in zip(keys, buffers):
+        cuts = offsets[k][1:-1]
+        pieces_by_group[k] = jnp.split(buf, cuts) if cuts else [buf]
+    leaves = []
+    for key, idx, shape, dtype in order:
+        piece = pieces_by_group[key][idx].reshape(shape)
+        if piece.dtype != dtype:
+            piece = piece.astype(dtype)
+        leaves.append(piece)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def pad_to_multiple(buf: jnp.ndarray, m: int) -> Tuple[jnp.ndarray, int]:
+    """Pad a flat buffer so its length divides ``m`` (needed by the
+    reduce-scatter leg of the two-dimensional communicator)."""
+    n = buf.shape[0]
+    rem = (-n) % m
+    if rem:
+        buf = jnp.concatenate([buf, jnp.zeros((rem,), buf.dtype)])
+    return buf, rem

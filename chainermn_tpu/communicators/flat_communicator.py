@@ -1,0 +1,23 @@
+"""Flat communicator — single packed-buffer allreduce.
+
+Reference (path unverified, SURVEY.md provenance): ``FlatCommunicator`` in
+〔chainermn/communicators/flat_communicator.py〕 — pack all grads into one
+contiguous GPU buffer, one CUDA-aware ``MPI.Allreduce`` over it, unpack.
+
+Here: concatenate all leaves into flat per-dtype buffers, one ``lax.psum``
+per buffer, split back.  The pack/unpack is traced; XLA owns the memory
+(reference's ``DeviceMemory`` staging disappears by design, SURVEY.md §2.3).
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators import _packing
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+
+
+class FlatCommunicator(MeshCommunicator):
+    def _allreduce_grad_traced(self, grads):
+        buffers, meta = _packing.pack(grads)
+        ax = self._axis_arg()
+        buffers = [lax.psum(b, ax) for b in buffers]
+        return _packing.unpack(buffers, meta, scale=1.0 / self.size)

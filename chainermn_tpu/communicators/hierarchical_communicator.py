@@ -1,0 +1,39 @@
+"""Hierarchical communicator — intra-slice reduce, inter-host allreduce.
+
+Reference (path unverified, SURVEY.md provenance):
+``HierarchicalCommunicator`` 〔chainermn/communicators/hierarchical_communicator.py〕
+— intra-node NCCL reduce -> inter-node MPI allreduce (host staged) ->
+intra-node NCCL bcast.  This is the component BASELINE.json:north_star maps
+onto ICI x DCN.
+
+Here the two legs are the two mesh axes: ``psum`` over the ``intra`` (ICI)
+axis first, then ``psum`` over the ``inter`` (DCN) axis.  In SPMD terms
+psum(intra) already leaves the intra-reduced value everywhere in the slice
+(reduce+bcast fused), so the NCCL-bcast third leg is implicit.  XLA lowers
+each psum to the collective native to that axis's interconnect.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+
+
+class HierarchicalCommunicator(MeshCommunicator):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if len(self._data_axes) < 2:
+            raise ValueError(
+                "hierarchical communicator needs a 2-axis (inter, intra) mesh; "
+                "use 'naive'/'flat'/'xla' for flat worlds")
+
+    def _allreduce_grad_traced(self, grads):
+        inter_axes = self._data_axes[:-1]
+        intra_axis = self._data_axes[-1]
+        n = self.size
+
+        def one(g):
+            g = lax.psum(g, intra_axis)        # ICI leg (reference: NCCL reduce)
+            g = lax.psum(g, inter_axes)        # DCN leg (reference: MPI allreduce)
+            return g / n
+        import jax
+        return jax.tree.map(one, grads)

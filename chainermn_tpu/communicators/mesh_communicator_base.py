@@ -1,0 +1,379 @@
+"""Concrete mesh-backed communicator machinery.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``MpiCommunicatorBase`` in 〔chainermn/communicators/mpi_communicator_base.py〕
+— the generic object/array transport shared by every communicator flavor,
+plus the rank bookkeeping of ``init_ranks``.
+
+TPU-native design (see ``communicator_base.py`` for the two-level model):
+
+* object ops delegate to the DCN control plane (host level);
+* array collectives are *traced* ops over the communicator's mesh axes —
+  XLA lowers them to ICI collectives; there is no hand-rolled transport,
+  no pinned staging, no >2 GiB chunking (XLA owns the data plane, which is
+  precisely the reference plumbing this rebuild deletes by design —
+  SURVEY.md §2.3);
+* ``run_spmd`` is the "mpiexec" analogue: it launches a per-device SPMD
+  region in which each device acts as one reference rank.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+from chainermn_tpu.parallel import topology as topo_mod
+from chainermn_tpu.runtime import control_plane as cp_mod
+
+
+class _SplitControlPlane(cp_mod.ControlPlane):
+    """Sub-world view over a parent control plane (reference: ``mpi_comm.Split``
+    〔mpi_communicator_base.py〕).  Tags are namespaced per split group."""
+
+    def __init__(self, parent: cp_mod.ControlPlane, members: List[int], color: int):
+        self._parent = parent
+        self._members = members  # parent ranks, ordered by (key, rank)
+        self._color = color
+        self.rank = members.index(parent.rank)
+        self.size = len(members)
+
+    def _tag(self, tag: int) -> int:
+        return (self._color + 1) * 100003 + tag
+
+    def send_obj(self, obj, dest, tag=0):
+        self._parent.send_obj(obj, self._members[dest], tag=self._tag(tag))
+
+    def recv_obj(self, source, tag=0):
+        return self._parent.recv_obj(self._members[source], tag=self._tag(tag))
+
+
+class MeshCommunicator(CommunicatorBase):
+    """Communicator bound to (mesh, data_axes, control plane).
+
+    Subclasses override :meth:`_allreduce_grad_traced` with their collective
+    decomposition — that decomposition is the only thing that distinguishes
+    the reference's communicator zoo (naive/flat/hierarchical/...), and the
+    same is true here.
+    """
+
+    # Only the xla (pure_nccl analogue) communicator accepts a communication
+    # dtype, mirroring create_communicator's restriction in the reference
+    # factory 〔communicators/__init__.py〕.
+    supports_allreduce_grad_dtype = False
+
+    def __init__(
+        self,
+        topology: Optional[topo_mod.Topology] = None,
+        mesh: Optional[Mesh] = None,
+        data_axes: Optional[Sequence[str]] = None,
+        allreduce_grad_dtype=None,
+        control_plane: Optional[cp_mod.ControlPlane] = None,
+        intra_size: Optional[int] = None,
+    ):
+        if topology is None:
+            topology = (topo_mod.topology_from_mesh(mesh) if mesh is not None
+                        else topo_mod.init_topology(intra_size=intra_size))
+        self._topology = topology
+        self._mesh = topology.mesh
+        self._data_axes: Tuple[str, ...] = tuple(data_axes or self._mesh.axis_names)
+        for ax in self._data_axes:
+            if ax not in self._mesh.shape:
+                raise ValueError(f"axis {ax!r} not in mesh {self._mesh.axis_names}")
+        if allreduce_grad_dtype is not None and not self.supports_allreduce_grad_dtype:
+            # Parity with the reference: only pure_nccl accepts the dtype knob.
+            raise ValueError(
+                f"{type(self).__name__} does not support allreduce_grad_dtype "
+                "(only the 'xla'/'pure_nccl' communicator does)")
+        self.allreduce_grad_dtype = (
+            jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype is not None else None)
+        self._cp = control_plane if control_plane is not None else cp_mod.get_control_plane()
+        self._jit_cache: dict = {}
+
+    # ---- topology ----------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        return self._data_axes
+
+    @property
+    def rank(self) -> int:
+        return self._cp.rank
+
+    @property
+    def size(self) -> int:
+        return int(np.prod([self._mesh.shape[a] for a in self._data_axes]))
+
+    @property
+    def host_size(self) -> int:
+        return self._cp.size
+
+    def _local_coords(self) -> Tuple[int, int]:
+        """(inter, intra) grid coordinates of this host's first device."""
+        grid = self._mesh.devices
+        first_local = None
+        for idx, d in np.ndenumerate(grid):
+            if d.process_index == jax.process_index():
+                first_local = idx
+                break
+        if first_local is None:
+            return (0, 0)
+        # Collapse to (leading axes, trailing axis) = (inter-ish, intra-ish).
+        return (int(first_local[0]) if len(first_local) > 1 else 0,
+                int(first_local[-1]))
+
+    @property
+    def intra_rank(self) -> int:
+        return self._local_coords()[1]
+
+    @property
+    def intra_size(self) -> int:
+        ax = self._data_axes[-1]
+        return int(self._mesh.shape[ax])
+
+    @property
+    def inter_rank(self) -> int:
+        return self._local_coords()[0]
+
+    @property
+    def inter_size(self) -> int:
+        return self.size // self.intra_size
+
+    # ---- object plane ------------------------------------------------------
+    def send_obj(self, obj, dest, tag=0):
+        self._cp.send_obj(obj, dest, tag=tag)
+
+    def recv_obj(self, source, tag=0):
+        return self._cp.recv_obj(source, tag=tag)
+
+    def bcast_obj(self, obj, root=0):
+        return self._cp.bcast_obj(obj, root=root)
+
+    def gather_obj(self, obj, root=0):
+        return self._cp.gather_obj(obj, root=root)
+
+    def allgather_obj(self, obj):
+        return self._cp.allgather_obj(obj)
+
+    def scatter_obj(self, objs, root=0):
+        return self._cp.scatter_obj(objs, root=root)
+
+    def allreduce_obj(self, obj, op="sum"):
+        return self._cp.allreduce_obj(obj, op=op)
+
+    def barrier(self):
+        self._cp.barrier()
+
+    # ---- SPMD context ------------------------------------------------------
+    def _axis_arg(self):
+        return self._data_axes if len(self._data_axes) > 1 else self._data_axes[0]
+
+    def in_spmd_context(self) -> bool:
+        """True when called under a trace where this communicator's mesh axes
+        are bound (i.e. inside :meth:`run_spmd` / a user ``shard_map``)."""
+        try:
+            lax.axis_index(self._axis_arg())
+            return True
+        except NameError:
+            return False
+
+    def axis_index(self):
+        """Device-level rank (0..size-1) — the reference's per-GPU ``rank``.
+        Only meaningful inside an SPMD region."""
+        return lax.axis_index(self._axis_arg())
+
+    def run_spmd(self, f: Callable, *stacked_args, jit: bool = True):
+        """Run ``f`` once per device, SPMD — the "mpiexec -n size" analogue.
+
+        Every leaf of every arg must have a leading axis of length ``size``
+        holding the per-rank values; results come back stacked the same way.
+        Inside ``f``, this communicator's traced collectives and
+        ``axis_index()`` behave like the reference's per-rank API.
+        """
+        spec = P(self._data_axes)
+
+        def per_rank(args):
+            squeezed = jax.tree.map(lambda a: jnp.squeeze(a, 0), args)
+            out = f(*squeezed)
+            return jax.tree.map(lambda a: jnp.expand_dims(a, 0), out)
+
+        fn = jax.shard_map(per_rank, mesh=self._mesh, in_specs=spec, out_specs=spec)
+        if jit:
+            fn = jax.jit(fn)
+        for i, arg in enumerate(stacked_args):
+            for leaf in jax.tree.leaves(arg):
+                shape = jnp.shape(leaf)
+                if not shape or shape[0] != self.size:
+                    raise ValueError(
+                        f"run_spmd arg {i}: expected leading per-rank axis of "
+                        f"length {self.size}, got shape {shape}")
+        return fn(tuple(stacked_args))
+
+    # ---- traced collectives ------------------------------------------------
+    def allreduce(self, x, op: str = "sum"):
+        ax = self._axis_arg()
+        if op == "sum":
+            return jax.tree.map(lambda v: lax.psum(v, ax), x)
+        if op == "mean":
+            return jax.tree.map(lambda v: lax.psum(v, ax) / self.size, x)
+        if op == "max":
+            return jax.tree.map(lambda v: lax.pmax(v, ax), x)
+        if op == "min":
+            return jax.tree.map(lambda v: lax.pmin(v, ax), x)
+        raise ValueError(f"unknown op {op!r}")
+
+    def bcast(self, x, root: int = 0):
+        idx = self.axis_index()
+        return jax.tree.map(
+            lambda v: lax.psum(jnp.where(idx == root, v, jnp.zeros_like(v)),
+                               self._axis_arg()),
+            x)
+
+    def allgather(self, x):
+        """Per-rank value -> stacked [size, ...] on every rank."""
+        return jax.tree.map(
+            lambda v: lax.all_gather(v, self._axis_arg(), tiled=False), x)
+
+    def gather(self, x, root: int = 0):
+        # SPMD has no asymmetric gather; every device gets the stacked result
+        # (root kept for API parity with the reference signature).
+        del root
+        return self.allgather(x)
+
+    def alltoall(self, xs):
+        """xs: per-rank array with leading axis == size (one slot per peer).
+        Returns the transposed exchange, as the reference's ``alltoall``."""
+        if len(self._data_axes) == 1:
+            return jax.tree.map(
+                lambda v: lax.all_to_all(v, self._data_axes[0], 0, 0, tiled=False),
+                xs)
+        # Multi-axis worlds: decompose as successive single-axis exchanges is
+        # incorrect in general; use a gather+slice fallback (correct, heavier).
+        idx = self.axis_index()
+        def one(v):
+            stacked = lax.all_gather(v, self._axis_arg(), tiled=False)  # [size, size, ...]
+            return lax.dynamic_index_in_dim(
+                jnp.swapaxes(stacked, 0, 1), idx, axis=0, keepdims=False)
+        return jax.tree.map(one, xs)
+
+    def scatter(self, x, root: int = 0):
+        """x: stacked [size, ...] (meaningful on root; SPMD requires the value
+        be present everywhere) -> this rank's slice."""
+        x = self.bcast(x, root=root)
+        idx = self.axis_index()
+        return jax.tree.map(
+            lambda v: lax.dynamic_index_in_dim(v, idx, axis=0, keepdims=False), x)
+
+    def reduce_scatter(self, x):
+        return jax.tree.map(
+            lambda v: lax.psum_scatter(v, self._axis_arg(), tiled=True), x)
+
+    def ppermute(self, x, perm: List[Tuple[int, int]]):
+        # lax.ppermute takes one axis name; that's fine as long as at most one
+        # data axis is non-trivial (size > 1).  Multi-axis worlds should
+        # split_axes() down to the axis they mean.
+        nontrivial = [a for a in self._data_axes if self._mesh.shape[a] > 1]
+        if len(nontrivial) > 1:
+            raise ValueError("ppermute requires a single non-trivial axis; "
+                             "use split_axes() to select one mesh axis")
+        axis = nontrivial[0] if nontrivial else self._data_axes[-1]
+        return jax.tree.map(lambda v: lax.ppermute(v, axis, perm), x)
+
+    # ---- gradient entry points ---------------------------------------------
+    def allreduce_grad(self, grads):
+        """Average gradients across the data-parallel world.
+
+        Reference: ``Communicator.allreduce_grad(model)``
+        〔communicator_base.py〕, in-place on ``param.grad``; here functional.
+
+        * Inside an SPMD region (``run_spmd`` / shard_map): performs this
+          communicator's collective decomposition (psum-mean over mesh axes).
+        * Eagerly in single-controller mode: gradients computed from a
+          globally-sharded batch are already the global mean (XLA inserted
+          the collective during backward); only the communication-dtype
+          roundtrip remains observable, and it is applied for numerical
+          parity with the reference's cast-allreduce-cast path.
+        """
+        if self.in_spmd_context():
+            return self._allreduce_grad_traced(grads)
+        if self.allreduce_grad_dtype is None:
+            return grads
+        dt = self.allreduce_grad_dtype
+        return jax.tree.map(lambda g: g.astype(dt).astype(g.dtype), grads)
+
+    # Upstream ChainerMN later renamed this; keep both spellings.
+    multi_node_mean_grad = allreduce_grad
+
+    def _allreduce_grad_traced(self, grads):
+        """Default decomposition (naive): per-leaf psum over all data axes.
+        Subclasses override — that *is* the communicator zoo."""
+        n = self.size
+        ax = self._axis_arg()
+        return jax.tree.map(lambda g: lax.psum(g, ax) / n, grads)
+
+    def bcast_data(self, params):
+        """Broadcast model parameters from rank 0 to the whole world.
+
+        Reference: ``Communicator.bcast_data(model)`` — called once after
+        model init so every worker starts from identical weights.
+        """
+        if self.in_spmd_context():
+            return self.bcast(params, root=0)
+        if self.host_size > 1:
+            host_vals = jax.device_get(params)
+            host_vals = self.bcast_obj(host_vals, root=0)
+            params = host_vals
+        repl = NamedSharding(self._mesh, P())
+        return jax.device_put(params, repl)
+
+    # ---- sub-communicators -------------------------------------------------
+    def split(self, color: int, key: int) -> "MeshCommunicator":
+        """Host-level split (reference: ``CommunicatorBase.split`` via
+        ``mpi_comm.Split``).  Hosts sharing ``color`` form a new world,
+        ranked by ``key``; the new communicator's mesh spans the member
+        hosts' devices."""
+        # Allgather both the control-plane rank and jax.process_index(): the
+        # two numberings need not agree (env-var bootstrap may order ranks
+        # differently), so device membership is decided by process_index.
+        infos = self.allgather_obj((color, key, self.rank, jax.process_index()))
+        group = sorted((t for t in infos if t[0] == color),
+                       key=lambda t: (t[1], t[2]))
+        members = [t[2] for t in group]
+        member_procs = {t[3] for t in group}
+        sub_cp = _SplitControlPlane(self._cp, members, color)
+        if self.host_size == 1:
+            sub_topo = self._topology
+        else:
+            devs = [d for d in self._mesh.devices.flat
+                    if d.process_index in member_procs]
+            sub_topo = topo_mod.init_topology(devices=devs)
+        return type(self)(topology=sub_topo, control_plane=sub_cp)
+
+    def split_axes(self, axes: Sequence[str]) -> "MeshCommunicator":
+        """TPU-idiomatic split: a communicator over a subset of this mesh's
+        axes (e.g. hybrid data x model parallelism on one mesh — the
+        factorization the reference reached via ``comm.split``).
+
+        Keeps this communicator's flavor (collective decomposition and
+        communication dtype) when the flavor's axis requirements still hold
+        on the sub-world; otherwise falls back to the generic per-leaf psum
+        communicator.
+        """
+        kwargs = {}
+        if self.supports_allreduce_grad_dtype and self.allreduce_grad_dtype is not None:
+            kwargs["allreduce_grad_dtype"] = self.allreduce_grad_dtype
+        try:
+            return type(self)(topology=self._topology, data_axes=tuple(axes),
+                              control_plane=self._cp, **kwargs)
+        except ValueError:
+            # e.g. hierarchical/two_dimensional need >= 2 axes
+            return MeshCommunicator(topology=self._topology, data_axes=tuple(axes),
+                                    control_plane=self._cp)

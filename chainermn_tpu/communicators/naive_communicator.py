@@ -1,0 +1,17 @@
+"""Naive communicator — per-parameter allreduce.
+
+Reference (path unverified, SURVEY.md provenance): ``NaiveCommunicator`` in
+〔chainermn/communicators/naive_communicator.py〕 — one ``MPI.Allreduce`` per
+parameter on host arrays; CPU-friendly, the test/CI workhorse.
+
+Here: one ``lax.psum`` per gradient leaf over all data axes.  XLA will often
+fuse/combine them anyway, but the decomposition is structurally per-leaf,
+matching the reference.  Works on any backend, including the virtual CPU mesh
+used by the test suite.
+"""
+
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+
+
+class NaiveCommunicator(MeshCommunicator):
+    pass  # the base's per-leaf psum *is* the naive decomposition

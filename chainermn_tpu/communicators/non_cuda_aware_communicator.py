@@ -1,0 +1,36 @@
+"""Host-staged communicator.
+
+Reference (path unverified, SURVEY.md provenance):
+``NonCudaAwareCommunicator`` 〔chainermn/communicators/non_cuda_aware_communicator.py〕
+— like flat, but stages GPU buffers through pinned host memory before MPI,
+for MPI builds that are not CUDA-aware.
+
+TPU-native interpretation: the eager path genuinely stages gradients through
+*host* memory and reduces across hosts over the DCN control plane — the
+debugging/escape-hatch path when one wants the data plane off the ICI (the
+exact role the reference class played).  Inside a traced SPMD region there is
+no host to stage through (XLA owns execution), so the traced decomposition
+falls back to flat-buffer psum and the class documents that staging is an
+eager-mode behavior.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
+
+
+class NonCudaAwareCommunicator(FlatCommunicator):
+    def allreduce_grad(self, grads):
+        if self.in_spmd_context():
+            # No host exists inside an XLA program; use the flat decomposition.
+            return self._allreduce_grad_traced(grads)
+        # Eager: device -> host -> (DCN mean across hosts) -> device, the
+        # staged path the reference implements with pinned buffers.
+        host = jax.device_get(grads)
+        if self.host_size > 1:
+            summed = self.allreduce_obj(host, op="sum")
+            host = jax.tree.map(lambda a: np.asarray(a) / self.host_size, summed)
+        repl = NamedSharding(self._mesh, P())
+        return jax.device_put(host, repl)

@@ -1,0 +1,26 @@
+"""Single-node communicator — ICI only.
+
+Reference (path unverified, SURVEY.md provenance): ``SingleNodeCommunicator``
+〔chainermn/communicators/single_node_communicator.py〕 — NCCL-only, asserts
+``size == intra_size``.  Here: asserts the world is one slice (no inter axis)
+and reduces over ICI alone.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+
+
+class SingleNodeCommunicator(MeshCommunicator):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.inter_size != 1:
+            raise ValueError(
+                f"single_node communicator requires inter_size == 1, got "
+                f"{self.inter_size}; use 'hierarchical' for multi-host worlds")
+
+    def _allreduce_grad_traced(self, grads):
+        import jax
+        intra_axis = self._data_axes[-1]
+        n = self.size
+        return jax.tree.map(lambda g: lax.psum(g, intra_axis) / n, grads)

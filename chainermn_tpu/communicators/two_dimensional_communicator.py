@@ -1,0 +1,40 @@
+"""Two-dimensional communicator — reduce-scatter / allreduce / all-gather.
+
+Reference (path unverified, SURVEY.md provenance):
+``TwoDimensionalCommunicator`` 〔chainermn/communicators/two_dimensional_communicator.py〕
+— intra-node NCCL reduce-scatter -> inter-node MPI allreduce of each shard ->
+intra-node NCCL allgather.  The bandwidth-optimal decomposition for fat nodes
+on thin inter-node links; maps directly onto the 2-D ICI torus here.
+
+Here, on a packed flat buffer: ``psum_scatter`` over ``intra`` (each chip in
+the slice owns 1/intra_size of the gradient), ``psum`` over ``inter`` of the
+owned shard, ``all_gather`` over ``intra``.  Every leg is the XLA collective
+native to its axis.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators import _packing
+from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
+
+
+class TwoDimensionalCommunicator(MeshCommunicator):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if len(self._data_axes) < 2:
+            raise ValueError(
+                "two_dimensional communicator needs a 2-axis (inter, intra) mesh")
+
+    def _allreduce_grad_traced(self, grads):
+        inter_axes = self._data_axes[:-1]
+        intra_axis = self._data_axes[-1]
+        intra_size = int(self._mesh.shape[intra_axis])
+        buffers, meta = _packing.pack(grads)
+        out = []
+        for buf in buffers:
+            buf, pad = _packing.pad_to_multiple(buf, intra_size)
+            shard = lax.psum_scatter(buf, intra_axis, tiled=True)   # ICI leg 1
+            shard = lax.psum(shard, inter_axes)                     # DCN leg
+            full = lax.all_gather(shard, intra_axis, tiled=True)    # ICI leg 2
+            out.append(full[:buf.shape[0] - pad] if pad else full)
+        return _packing.unpack(out, meta, scale=1.0 / self.size)

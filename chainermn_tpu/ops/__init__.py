@@ -1,0 +1,3 @@
+from chainermn_tpu.ops.cast_scale import cast_scale
+
+__all__ = ["cast_scale"]

@@ -1,0 +1,126 @@
+"""Mesh / topology discovery — the TPU-native analogue of the reference's
+rank bookkeeping.
+
+Reference behavior being rebuilt (paths unverified, see SURVEY.md provenance):
+``init_ranks`` in 〔chainermn/communicators/_communication_utility.py〕
+allgathers hostnames over MPI and derives ``(global_rank, intra_rank,
+intra_size, inter_rank, inter_size)``, then builds intra-/inter-node
+sub-communicators by ``mpi_comm.Split``.
+
+On TPU there is no MPI world: topology comes from the device list itself
+(`jax.devices()`, each device's ``process_index``), arranged into a
+:class:`jax.sharding.Mesh` whose two canonical axes mirror the reference's
+two-level hierarchy:
+
+* ``"inter"`` — the DCN / cross-host axis (the reference's inter-node MPI leg)
+* ``"intra"`` — the ICI / within-slice axis (the reference's intra-node NCCL leg)
+
+Collectives over ``intra`` ride the chip interconnect; collectives over
+``inter`` cross hosts.  Hierarchical / two-dimensional communicators factor
+their allreduce over these axes exactly like the reference factors NCCL x MPI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names.  "inter" = DCN (cross-host), "intra" = ICI (in-slice).
+INTER_AXIS = "inter"
+INTRA_AXIS = "intra"
+DATA_AXES: Tuple[str, str] = (INTER_AXIS, INTRA_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """An immutable view of the device mesh plus host-level rank info.
+
+    ``host_rank`` / ``host_size`` describe the *controller process* grid (the
+    analogue of the reference's MPI ranks: one process per host instead of one
+    per GPU).  The device-level parallel degree lives in ``mesh``.
+    """
+
+    mesh: Mesh
+    host_rank: int
+    host_size: int
+
+    @property
+    def size(self) -> int:
+        """Total number of devices participating in data-parallel collectives."""
+        return int(self.mesh.devices.size)
+
+    @property
+    def inter_size(self) -> int:
+        return int(self.mesh.shape[INTER_AXIS]) if INTER_AXIS in self.mesh.shape else 1
+
+    @property
+    def intra_size(self) -> int:
+        return int(self.mesh.shape[INTRA_AXIS]) if INTRA_AXIS in self.mesh.shape else 1
+
+    # -- shardings -----------------------------------------------------------
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def data_sharding(self, *trailing_axes) -> NamedSharding:
+        """Sharding that splits a leading batch axis across all data devices."""
+        return NamedSharding(self.mesh, P(DATA_AXES, *trailing_axes))
+
+
+def _sorted_devices(devices: Sequence[jax.Device]) -> list:
+    # Group by owning process first so the "intra" axis maps to devices that
+    # actually share a host (== share ICI on real TPU slices), then by id for
+    # a deterministic order.  Mirrors the reference's hostname-major ranking.
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def init_topology(
+    devices: Optional[Sequence[jax.Device]] = None,
+    intra_size: Optional[int] = None,
+) -> Topology:
+    """Discover the (inter, intra) device grid.
+
+    Reference analogue: ``init_ranks`` 〔_communication_utility.py〕, except the
+    "hostname allgather" is replaced by reading ``device.process_index`` off
+    the already-global device list — no collective needed to bootstrap.
+
+    Args:
+      devices: devices to use (default: all of ``jax.devices()``).
+      intra_size: override the size of the intra (ICI) axis.  Defaults to the
+        number of devices per process when running multi-process, else all
+        devices (single-controller: the whole slice is one ICI domain).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    devices = _sorted_devices(devices)
+    n = len(devices)
+    if intra_size is None:
+        procs = sorted({d.process_index for d in devices})
+        if len(procs) > 1:
+            per_proc = [sum(1 for d in devices if d.process_index == p) for p in procs]
+            intra_size = per_proc[0] if len(set(per_proc)) == 1 else 1
+        else:
+            intra_size = n
+    if n % intra_size != 0:
+        raise ValueError(
+            f"device count {n} is not divisible by intra_size {intra_size}")
+    inter_size = n // intra_size
+    grid = np.asarray(devices, dtype=object).reshape(inter_size, intra_size)
+    mesh = Mesh(grid, (INTER_AXIS, INTRA_AXIS))
+    return Topology(
+        mesh=mesh,
+        host_rank=jax.process_index(),
+        host_size=jax.process_count(),
+    )
+
+
+def topology_from_mesh(mesh: Mesh) -> Topology:
+    """Wrap a user-supplied mesh.  Axes other than (inter, intra) are allowed;
+    communicators are told which axes are theirs via ``data_axes``."""
+    return Topology(
+        mesh=mesh,
+        host_rank=jax.process_index(),
+        host_size=jax.process_count(),
+    )

@@ -1,0 +1,183 @@
+"""Host-level object transport — the control plane.
+
+Reference behavior being rebuilt (paths unverified, see SURVEY.md provenance):
+the MPI side of 〔chainermn/communicators/mpi_communicator_base.py〕 — pickled
+object ``send/recv/bcast/gather/scatter/allreduce_obj`` between ranks, plus the
+bootstrap handshake of 〔_communication_utility.py〕.
+
+On TPU the data plane (gradients, activations) is XLA collectives over ICI and
+never touches this module.  The control plane carries *small Python objects*
+between controller processes over DCN: dataset shards, metric dicts, seeds,
+barrier tokens.  The reference used MPI for this; we use a socket transport
+(C++ framing core in ``chainermn_tpu/runtime/dcn_transport.cpp`` with a
+pure-Python fallback — see ``transport.py``).
+
+Single-controller runs (the common TPU case: one process driving the whole
+slice) get :class:`SingleProcessControlPlane`, where every op is local.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import pickle
+from typing import Any, Callable, List, Optional
+
+_REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, lambda a, b: a + b),
+    "max": lambda xs: _tree_reduce(xs, max),
+    "min": lambda xs: _tree_reduce(xs, min),
+}
+
+
+def _tree_reduce(xs, op):
+    out = xs[0]
+    for x in xs[1:]:
+        if isinstance(out, dict):
+            out = {k: op(out[k], x[k]) for k in out}
+        elif isinstance(out, (list, tuple)):
+            out = type(out)(op(a, b) for a, b in zip(out, x))
+        else:
+            out = op(out, x)
+    return out
+
+
+class ControlPlane(abc.ABC):
+    """Abstract host-level object transport (the reference's MPI role)."""
+
+    rank: int
+    size: int
+
+    @abc.abstractmethod
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None: ...
+
+    @abc.abstractmethod
+    def recv_obj(self, source: int, tag: int = 0) -> Any: ...
+
+    def bcast_obj(self, obj: Any, root: int = 0, tag: int = 0) -> Any:
+        if self.size == 1:
+            return obj
+        if self.rank == root:
+            for r in range(self.size):
+                if r != root:
+                    self.send_obj(obj, r, tag=tag)
+            return obj
+        return self.recv_obj(root, tag=tag)
+
+    def gather_obj(self, obj: Any, root: int = 0, tag: int = 0) -> Optional[List[Any]]:
+        if self.size == 1:
+            return [obj]
+        if self.rank == root:
+            out = []
+            for r in range(self.size):
+                out.append(obj if r == root else self.recv_obj(r, tag=tag))
+            return out
+        self.send_obj(obj, root, tag=tag)
+        return None
+
+    def allgather_obj(self, obj: Any, tag: int = 0) -> List[Any]:
+        gathered = self.gather_obj(obj, root=0, tag=tag)
+        return self.bcast_obj(gathered, root=0, tag=tag + 1)
+
+    def scatter_obj(self, objs: Optional[List[Any]], root: int = 0, tag: int = 0) -> Any:
+        if self.size == 1:
+            return objs[0]
+        if self.rank == root:
+            assert objs is not None and len(objs) == self.size
+            for r in range(self.size):
+                if r != root:
+                    self.send_obj(objs[r], r, tag=tag)
+            return objs[root]
+        return self.recv_obj(root, tag=tag)
+
+    def allreduce_obj(self, obj: Any, op: str = "sum", tag: int = 0) -> Any:
+        """Reference analogue: ``allreduce_obj`` on the communicator base —
+        reduce pickled objects (numbers / dicts / nested) across hosts."""
+        xs = self.allgather_obj(obj, tag=tag)
+        return _REDUCE_OPS[op](xs)
+
+    def barrier(self, tag: int = 900) -> None:
+        self.allgather_obj(None, tag=tag)
+
+
+class SingleProcessControlPlane(ControlPlane):
+    """Degenerate world: one controller process (the usual single-host case)."""
+
+    def __init__(self):
+        self.rank = 0
+        self.size = 1
+        self._loopback: dict = {}
+
+    def send_obj(self, obj, dest, tag=0):
+        if dest != 0:
+            raise ValueError(f"invalid dest {dest} in a single-process world")
+        # Loopback send-to-self: buffer it (used by tests / rank-agnostic code)
+        self._loopback.setdefault(tag, []).append(pickle.dumps(obj))
+
+    def recv_obj(self, source, tag=0):
+        if source != 0 or not self._loopback.get(tag):
+            raise ValueError("nothing to receive in a single-process world")
+        return pickle.loads(self._loopback[tag].pop(0))
+
+
+class SocketControlPlane(ControlPlane):
+    """Multi-process control plane over the DCN socket transport.
+
+    Bootstrap mirrors the reference's ``init_ranks`` handshake
+    〔_communication_utility.py〕: every process registers its listen address
+    with the coordinator (rank 0), which broadcasts the full peer table —
+    the "hostname allgather" of the MPI world, done over DCN.
+    """
+
+    def __init__(self, rank: int, size: int, coordinator: str, transport=None):
+        from chainermn_tpu.runtime import transport as transport_mod
+
+        self.rank = rank
+        self.size = size
+        self._tp = transport or transport_mod.create_transport(rank, size, coordinator)
+
+    def send_obj(self, obj, dest, tag=0):
+        self._tp.send(dest, tag, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def recv_obj(self, source, tag=0):
+        return pickle.loads(self._tp.recv(source, tag))
+
+    def shutdown(self):
+        self._tp.close()
+
+
+_DEFAULT_PLANE: Optional[ControlPlane] = None
+
+
+def get_control_plane() -> ControlPlane:
+    """Return the process-wide default control plane (memoized — the socket
+    bootstrap must run exactly once per process, like ``MPI_Init``).
+
+    Env contract (the no-MPI-launcher bootstrap, BASELINE.json:north_star):
+      CHAINERMN_TPU_COORDINATOR=host:port, CHAINERMN_TPU_NUM_PROCESSES,
+      CHAINERMN_TPU_PROCESS_ID — or fall back to jax.process_* discovery,
+      or a single-process world.
+    """
+    global _DEFAULT_PLANE
+    if _DEFAULT_PLANE is None:
+        _DEFAULT_PLANE = _create_control_plane()
+    return _DEFAULT_PLANE
+
+
+def _create_control_plane() -> ControlPlane:
+    coord = os.environ.get("CHAINERMN_TPU_COORDINATOR")
+    if coord:
+        rank = int(os.environ["CHAINERMN_TPU_PROCESS_ID"])
+        size = int(os.environ["CHAINERMN_TPU_NUM_PROCESSES"])
+        return SocketControlPlane(rank, size, coord)
+    import jax
+
+    if jax.process_count() > 1:
+        # jax.distributed already bootstrapped; piggyback a socket world on the
+        # same hosts using the coordinator address convention.
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if coord:
+            host, port = coord.rsplit(":", 1)
+            return SocketControlPlane(
+                jax.process_index(), jax.process_count(), f"{host}:{int(port) + 1}")
+    return SingleProcessControlPlane()

@@ -1,0 +1,185 @@
+"""DCN point-to-point byte transport.
+
+Wire layer under :mod:`chainermn_tpu.runtime.control_plane`.  Two backends:
+
+* the native C++ framing core (``dcn_transport.cpp``, loaded via ctypes) —
+  the rebuild's analogue of the reference's native MPI/NCCL surface
+  (SURVEY.md §2.3); and
+* this pure-Python fallback (same wire format), always available.
+
+Wire format (identical for both backends so they interoperate):
+  frame := u32 src | u32 tag | u64 len | len bytes payload
+Handshake: every rank connects to the coordinator (rank 0) and sends its
+listen address; rank 0 replies with the full peer table.  This mirrors the
+reference's hostname-allgather bootstrap 〔_communication_utility.py〕.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Tuple
+
+_HDR = struct.Struct("<IIQ")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class PyTransport:
+    """Pure-Python full-mesh TCP transport with a listener thread per rank."""
+
+    def __init__(self, rank: int, size: int, coordinator: str):
+        self.rank = rank
+        self.size = size
+        self._inbox: Dict[Tuple[int, int], queue.Queue] = {}
+        self._inbox_lock = threading.Lock()
+        self._out: Dict[int, socket.socket] = {}
+        # Per-destination locks: one slow peer must not serialize the whole
+        # outbound plane (bcast from rank 0 fans out concurrently).
+        self._out_locks: Dict[int, threading.Lock] = {}
+        self._out_locks_guard = threading.Lock()
+        self._closed = False
+
+        # Listen on an ephemeral port; learn everyone's address via rank 0.
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(size + 8)
+        my_port = self._listener.getsockname()[1]
+        my_host = os.environ.get("CHAINERMN_TPU_HOST", "127.0.0.1")
+
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+        chost, cport = coordinator.rsplit(":", 1)
+        self.peers = self._handshake(chost, int(cport), f"{my_host}:{my_port}")
+
+    # -- bootstrap -----------------------------------------------------------
+    def _handshake(self, chost: str, cport: int, my_addr: str):
+        if self.rank == 0:
+            table = {0: my_addr}
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((chost if chost not in ("127.0.0.1", "localhost") else "0.0.0.0", cport))
+            srv.listen(self.size + 8)
+            conns = []
+            while len(table) < self.size:
+                c, _ = srv.accept()
+                r, _, payload = self._read_frame(c)
+                table[r] = payload.decode()
+                conns.append((r, c))
+            blob = json.dumps(sorted(table.items())).encode()
+            for r, c in conns:
+                self._write_frame(c, 0, 0, blob)
+                c.close()
+            srv.close()
+            return dict(sorted(table.items()))
+        # Non-root: register with coordinator, get the table back.
+        deadline = time.time() + 60
+        while True:
+            try:
+                c = socket.create_connection((chost, cport), timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        self._write_frame(c, self.rank, 0, my_addr.encode())
+        _, _, blob = self._read_frame(c)
+        c.close()
+        # JSON, not pickle/eval: the handshake reads from an unauthenticated
+        # socket and must not be able to execute anything.
+        return {int(r): addr for r, addr in json.loads(blob.decode())}
+
+    # -- framing -------------------------------------------------------------
+    @staticmethod
+    def _write_frame(sock, src, tag, payload: bytes):
+        sock.sendall(_HDR.pack(src, tag, len(payload)) + payload)
+
+    @staticmethod
+    def _read_frame(sock):
+        src, tag, n = _HDR.unpack(_recv_exact(sock, _HDR.size))
+        return src, tag, _recv_exact(sock, n)
+
+    # -- receive path --------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader_loop, args=(conn,), daemon=True).start()
+
+    def _reader_loop(self, conn):
+        try:
+            while True:
+                src, tag, payload = self._read_frame(conn)
+                self._q(src, tag).put(payload)
+        except (ConnectionError, OSError):
+            conn.close()
+
+    def _q(self, src, tag):
+        with self._inbox_lock:
+            return self._inbox.setdefault((src, tag), queue.Queue())
+
+    # -- public API ----------------------------------------------------------
+    def send(self, dest: int, tag: int, payload: bytes):
+        if dest == self.rank:
+            self._q(self.rank, tag).put(payload)
+            return
+        with self._out_locks_guard:
+            lock = self._out_locks.setdefault(dest, threading.Lock())
+        with lock:
+            sock = self._out.get(dest)
+            if sock is None:
+                host, port = self.peers[dest].rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)), timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._out[dest] = sock
+            self._write_frame(sock, self.rank, tag, payload)
+
+    def recv(self, source: int, tag: int, timeout: float = 300.0) -> bytes:
+        try:
+            return self._q(source, tag).get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"recv from rank {source} (tag {tag}) timed out after {timeout}s"
+            ) from None
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in list(self._out.values()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._out.clear()
+
+
+def create_transport(rank: int, size: int, coordinator: str):
+    """Prefer the native C++ core; fall back to pure Python (same protocol)."""
+    if os.environ.get("CHAINERMN_TPU_PURE_PY_TRANSPORT") != "1":
+        try:
+            from chainermn_tpu.runtime.native import NativeTransport
+
+            return NativeTransport(rank, size, coordinator)
+        except (ImportError, OSError):
+            pass
+    return PyTransport(rank, size, coordinator)
