@@ -1,0 +1,15 @@
+from chainermn_tpu.datasets.scatter_dataset import (
+    SubDataset,
+    TupleDataset,
+    scatter_dataset,
+    scatter_index,
+)
+from chainermn_tpu.datasets.synthetic import make_classification
+
+__all__ = [
+    "SubDataset",
+    "TupleDataset",
+    "scatter_dataset",
+    "scatter_index",
+    "make_classification",
+]

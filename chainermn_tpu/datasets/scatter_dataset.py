@@ -1,0 +1,107 @@
+"""Dataset scattering across hosts.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``scatter_dataset`` in 〔chainermn/datasets/scatter_dataset.py〕 — rank 0
+draws a permutation (``shuffle``, ``seed``), slices the dataset into
+``comm.size`` near-equal ``SubDataset`` shards and ``comm.scatter``-s the
+pickled shards; every rank returns its shard.
+
+TPU-native re-interpretation: sharding is **by host** (controller process),
+not by device — within a host the global batch is sharded over devices by
+the train step's input sharding, which together reproduces the reference's
+per-GPU sharding.  Only the *seed* crosses the control plane (rank 0
+broadcasts it); each host then computes the identical permutation locally
+and takes its slice, so the global example order is a pure function of
+(seed, len(dataset)) — identical regardless of host count (determinism
+requirement, SURVEY.md §7 hard part 4) — and no pickled data moves at all.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class TupleDataset:
+    """Minimal dataset over parallel arrays (the Chainer TupleDataset role)."""
+
+    def __init__(self, *arrays):
+        n = len(arrays[0])
+        for a in arrays:
+            if len(a) != n:
+                raise ValueError("all arrays must share their first dimension")
+        self._arrays = arrays
+
+    def __len__(self):
+        return len(self._arrays[0])
+
+    def __getitem__(self, i):
+        return tuple(a[i] for a in self._arrays)
+
+
+class SubDataset:
+    """A view of ``dataset`` through an index array (reference:
+    ``chainer.datasets.SubDataset`` as used by ``scatter_dataset``)."""
+
+    def __init__(self, dataset, indices: np.ndarray):
+        self._dataset = dataset
+        self._indices = np.asarray(indices)
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, i):
+        return self._dataset[int(self._indices[i])]
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+
+def scatter_index(n_total: int, comm, *, force_equal_length: bool = True):
+    """Partition ``range(n_total)`` across hosts (upstream ChainerMN's
+    ``scatter_index``): returns this host's index array."""
+    return _host_slice(np.arange(n_total), comm.rank, comm.host_size,
+                       force_equal_length)
+
+
+def _host_slice(order: np.ndarray, rank: int, size: int,
+                force_equal_length: bool) -> np.ndarray:
+    n = len(order)
+    per = -(-n // size)  # ceil
+    if force_equal_length:
+        # Pad by wrapping (reference behavior: every shard equal length so
+        # every rank runs the same number of iterations per epoch).
+        # np.resize repeats cyclically, covering even n < size.
+        padded = np.resize(order, per * size)
+        return padded[rank * per:(rank + 1) * per]
+    return order[min(rank * per, n): min((rank + 1) * per, n)]
+
+
+def scatter_dataset(
+    dataset,
+    comm,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    force_equal_length: bool = True,
+    root: int = 0,
+) -> SubDataset:
+    """Shard ``dataset`` across the communicator's hosts.
+
+    Reference signature 〔datasets/scatter_dataset.py〕:
+    ``scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None)``.
+    Rank ``root`` decides the seed; every host derives the same global
+    permutation from it and takes its own contiguous slice.
+    """
+    if comm.rank == root and shuffle and seed is None:
+        seed = int(np.random.randint(0, 2**31 - 1))
+    seed = comm.bcast_obj(seed, root=root)
+    n = len(dataset)
+    if shuffle:
+        order = np.random.RandomState(seed).permutation(n)
+    else:
+        order = np.arange(n)
+    local = _host_slice(order, comm.rank, comm.host_size, force_equal_length)
+    return SubDataset(dataset, local)

@@ -1,0 +1,19 @@
+from chainermn_tpu.extensions.multi_node_evaluator import (
+    create_multi_node_evaluator,
+    make_eval_fn,
+)
+from chainermn_tpu.extensions.allreduce_persistent import (
+    AllreducePersistent,
+    allreduce_persistent,
+)
+from chainermn_tpu.extensions.checkpoint import (
+    create_multi_node_checkpointer,
+)
+
+__all__ = [
+    "create_multi_node_evaluator",
+    "make_eval_fn",
+    "AllreducePersistent",
+    "allreduce_persistent",
+    "create_multi_node_checkpointer",
+]

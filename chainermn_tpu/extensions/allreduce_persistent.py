@@ -1,0 +1,61 @@
+"""Persistent-value (BatchNorm statistics) synchronization.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``AllreducePersistent`` in 〔chainermn/extensions/allreduce_persistent.py〕 —
+a trainer extension that allreduce-averages *persistent* (non-gradient)
+arrays, i.e. BatchNorm running mean/var, so rank-0 snapshots and evaluation
+see consistent statistics.  The reference deliberately trains BatchNorm on
+*local* statistics and only syncs here (SURVEY.md §7 hard part 5) — psum-ing
+BN inside the step would silently change semantics, so this rebuild keeps
+the same posture: ``batch_stats`` stay device-varying during training and
+this extension folds them together on demand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def allreduce_persistent(stacked_stats, communicator):
+    """Average device-varying persistent state.
+
+    ``stacked_stats`` leaves have a leading per-device axis of length
+    ``comm.size`` (the layout the train step keeps ``batch_stats`` in).
+    Returns the same stacked layout with every slice replaced by the mean —
+    the reference's in-place allreduce of each persistent array.  Cross-host
+    averaging rides the same psum (the mesh spans all hosts).
+    """
+    comm = communicator
+
+    def body(s):
+        mean = comm.allreduce(s, "mean")
+        return mean
+
+    out = comm.run_spmd(body, stacked_stats)
+    return out
+
+
+class AllreducePersistent:
+    """Trainer-extension form (reference class name kept).
+
+    ``state_getter(trainer) -> stacked batch_stats`` and
+    ``state_setter(trainer, new_stats)`` adapt it to wherever the updater
+    keeps model state.
+    """
+
+    priority = 70
+    trigger = (1, "epoch")
+
+    def __init__(self, communicator, state_getter, state_setter):
+        self._comm = communicator
+        self._get = state_getter
+        self._set = state_setter
+
+    def __call__(self, trainer):
+        stats = self._get(trainer)
+        if stats is None:
+            return
+        self._set(trainer, allreduce_persistent(stats, self._comm))

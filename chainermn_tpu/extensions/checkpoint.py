@@ -1,0 +1,115 @@
+"""Distributed checkpointing with generation GC and auto-resume.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``create_multi_node_checkpointer`` in 〔chainermn/extensions/checkpoint.py〕
+— each rank saves its own state under a shared name/path, old generations
+are garbage-collected, and on startup ``resume()`` restores all ranks from
+the latest generation present on *every* rank (crash recovery for long
+multi-node runs; the reference's only failure-recovery mechanism —
+fail-stop + snapshot/resume, SURVEY.md §5.3, a posture this rebuild keeps).
+
+TPU-native form: per-host npz files of the flattened state pytree
+(``{path}/{name}.{iteration}.rank{r}.npz``); consistency of a generation is
+agreed over the control plane (allgather of locally available generations,
+intersect, take max).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten_state(state) -> Tuple[dict, Any]:
+    leaves, treedef = jax.tree.flatten(state)
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(l))
+              for i, l in enumerate(leaves)}
+    return arrays, treedef
+
+
+def _unflatten_state(arrays: dict, treedef, like_leaves: List[Any]):
+    leaves = [arrays[f"leaf_{i}"] for i in range(len(like_leaves))]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class _MultiNodeCheckpointer:
+    def __init__(self, comm, path: str, name: str, keep: int = 2):
+        self.comm = comm
+        self.path = path
+        self.name = name
+        self.keep = keep
+        os.makedirs(path, exist_ok=True)
+
+    # -- naming --------------------------------------------------------------
+    def _file(self, iteration: int, rank: Optional[int] = None) -> str:
+        r = self.comm.rank if rank is None else rank
+        return os.path.join(self.path,
+                            f"{self.name}.{iteration}.rank{r}.npz")
+
+    def _local_generations(self) -> List[int]:
+        pat = re.compile(
+            rf"^{re.escape(self.name)}\.(\d+)\.rank{self.comm.rank}\.npz$")
+        gens = []
+        for f in os.listdir(self.path):
+            m = pat.match(f)
+            if m:
+                gens.append(int(m.group(1)))
+        return sorted(gens)
+
+    # -- save / GC -----------------------------------------------------------
+    def save(self, state, iteration: int):
+        arrays, _ = _flatten_state(state)
+        # np.savez appends .npz when missing, so the temp name must end in it
+        tmp = self._file(iteration) + ".tmp.npz"
+        np.savez(tmp, **arrays)
+        os.replace(tmp, self._file(iteration))  # atomic publish
+        self._gc()
+
+    def _gc(self):
+        gens = self._local_generations()
+        for g in gens[:-self.keep] if self.keep else []:
+            try:
+                os.remove(self._file(g))
+            except OSError:
+                pass
+
+    # -- resume --------------------------------------------------------------
+    def latest_consistent_generation(self) -> Optional[int]:
+        local = set(self._local_generations())
+        all_gens = self.comm.allgather_obj(sorted(local))
+        common = set(all_gens[0])
+        for g in all_gens[1:]:
+            common &= set(g)
+        return max(common) if common else None
+
+    def resume(self, state):
+        """Restore the latest consistent generation into ``state``'s
+        structure.  Returns ``(state, iteration)``; ``iteration`` is None
+        when nothing could be resumed (fresh start)."""
+        gen = self.latest_consistent_generation()
+        if gen is None:
+            return state, None
+        leaves, treedef = jax.tree.flatten(state)
+        with np.load(self._file(gen)) as data:
+            arrays = {k: data[k] for k in data.files}
+        restored = _unflatten_state(arrays, treedef, leaves)
+        # preserve shardings of the live state
+        restored = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding)
+            if hasattr(old, "sharding") else new,
+            restored, state)
+        return restored, gen
+
+    def finalize(self):
+        self.comm.barrier()
+
+
+def create_multi_node_checkpointer(communicator, path: str,
+                                   name: str = "snapshot", keep: int = 2):
+    """Reference signature: ``create_multi_node_checkpointer(name, comm,
+    path=...)`` 〔extensions/checkpoint.py〕."""
+    return _MultiNodeCheckpointer(communicator, path, name, keep)

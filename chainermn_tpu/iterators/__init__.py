@@ -1,0 +1,11 @@
+from chainermn_tpu.iterators.serial_iterator import SerialIterator
+from chainermn_tpu.iterators.multi_node_iterator import (
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+__all__ = [
+    "SerialIterator",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+]

@@ -1,0 +1,91 @@
+"""Multi-node iterators.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔chainermn/iterators/〕 — ``create_multi_node_iterator(iterator, comm)``:
+the master (rank 0) iterates the real dataset and broadcasts each batch;
+other ranks' iterators are receive-only proxies.  Used when the dataset
+cannot be sharded.  ``create_synchronized_iterator`` instead synchronizes
+the random state so every rank draws identical batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _MasterIterator:
+    def __init__(self, iterator, comm, rank_master: int, tag: int = 700):
+        self._it = iterator
+        self._comm = comm
+        self._master = rank_master
+        self._tag = tag
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = self._it.next()
+            payload = ("batch", batch, self._it.epoch, self._it.is_new_epoch)
+        except StopIteration:
+            payload = ("stop", None, self._it.epoch, False)
+        payload = self._comm.bcast_obj(payload, root=self._master)
+        if payload[0] == "stop":
+            raise StopIteration
+        return payload[1]
+
+    next = __next__
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
+
+
+class _SlaveIterator:
+    def __init__(self, comm, rank_master: int):
+        self._comm = comm
+        self._master = rank_master
+        self.epoch = 0
+        self.is_new_epoch = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, batch, epoch, new_epoch = self._comm.bcast_obj(
+            None, root=self._master)
+        self.epoch = epoch
+        self.is_new_epoch = new_epoch
+        if kind == "stop":
+            raise StopIteration
+        return batch
+
+    next = __next__
+
+
+def create_multi_node_iterator(actual_iterator, communicator,
+                               rank_master: int = 0):
+    """Reference: rank-0-feeds-everyone iterator.  On the master host pass
+    the real iterator; other hosts may pass ``None``."""
+    if communicator.rank == rank_master:
+        return _MasterIterator(actual_iterator, communicator, rank_master)
+    return _SlaveIterator(communicator, rank_master)
+
+
+def create_synchronized_iterator(actual_iterator, communicator):
+    """Synchronize the iterator's RNG across hosts so every host draws the
+    same batch order (reference: ``create_synchronized_iterator``)."""
+    seed = None
+    if communicator.rank == 0:
+        seed = int(np.random.randint(0, 2**31 - 1))
+    seed = communicator.bcast_obj(seed, root=0)
+    if not hasattr(actual_iterator, "_rng"):
+        # Silently returning an unsynchronized iterator would be exactly the
+        # divergence this function exists to prevent.
+        raise TypeError(
+            f"{type(actual_iterator).__name__} exposes no _rng to "
+            "synchronize; use SerialIterator or synchronize it manually "
+            "with the broadcast seed")
+    actual_iterator._rng = np.random.RandomState(seed)
+    if hasattr(actual_iterator, "reset"):
+        actual_iterator.reset()
+    return actual_iterator

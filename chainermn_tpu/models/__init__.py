@@ -1,0 +1,3 @@
+from chainermn_tpu.models.mlp import MLP
+
+__all__ = ["MLP"]

@@ -1,0 +1,23 @@
+"""MLP — the reference MNIST example's model.
+
+Reference 〔examples/mnist/train_mnist.py〕 (path unverified, SURVEY.md
+provenance): a 784-1000-1000-10 ReLU MLP.  Rebuilt in flax.linen (the
+define-by-run Chainer Chain role in the JAX world).
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)

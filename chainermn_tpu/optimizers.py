@@ -1,0 +1,209 @@
+"""Multi-node optimizers — gradient allreduce woven into the update step.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔chainermn/optimizers.py〕 — ``create_multi_node_optimizer(opt, comm,
+double_buffering=False)`` wraps any Chainer optimizer so ``update()`` runs
+local forward/backward, then ``comm.allreduce_grad(model)``, then the inner
+update rule; ``_DoubleBufferingOptimizer`` (the fork's flagship) keeps two
+gradient buffer sets and a dedicated CUDA stream so the allreduce of step
+t-1's gradients overlaps the forward/backward of step t, applying averaged
+gradients with one step of staleness.
+
+TPU-native design: the wrapped object is an **optax GradientTransformation**
+(the Chainer-optimizer role in the JAX world) and the overlap is expressed as
+*dataflow*, not streams.  In :class:`_DoubleBufferingOptimizer`, ``update``
+allreduces the gradients stored from the previous step and stashes the fresh
+local gradients for the next one.  Inside the jitted train step the psum of
+the stale gradients has no data dependency on the current forward/backward,
+so XLA's latency-hiding scheduler is free to overlap the collective with
+compute — the very overlap the reference engineered with a side stream, here
+obtained from the compiler.  The 1-step-staleness semantics (first update
+applies zero gradients) are preserved exactly, because they are what changes
+convergence (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class _MultiNodeOptimizer:
+    """optax-compatible wrapper: allreduce-mean the grads, then inner update.
+
+    Reference: ``_MultiNodeOptimizer`` 〔optimizers.py〕, which delegated all
+    attributes to the wrapped optimizer; here the optax interface is two
+    functions, so delegation is explicit (`init`/`update` + passthrough).
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+        self.actual_optimizer = actual_optimizer
+        self.communicator = comm
+
+    def init(self, params):
+        return self.actual_optimizer.init(params)
+
+    def update(self, grads, state, params=None, **kwargs):
+        grads = self.communicator.allreduce_grad(grads)
+        return self.actual_optimizer.update(grads, state, params, **kwargs)
+
+    # pytree spec of this optimizer's state inside an SPMD train step:
+    # everything is device-invariant (replicated).
+    def state_partition_spec(self):
+        return P()
+
+
+class _DoubleBufferState(NamedTuple):
+    inner: Any            # wrapped optimizer's state (replicated)
+    pending: Any          # previous step's *local* grads (device-varying)
+    step: jnp.ndarray     # update counter
+
+
+class _DoubleBufferingOptimizer:
+    """The fork's double-buffered optimizer, as dataflow.
+
+    Semantics (reference 〔optimizers.py〕, SURVEY.md §3.4): update at step t
+    applies the allreduced gradients of step t-1 (1-step staleness); step 0
+    applies zero gradients (buffers start zero-filled).  The allreduce of the
+    pending buffer is independent of step t's forward/backward, which is what
+    lets the collective overlap compute under XLA's scheduler.
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+        self.actual_optimizer = actual_optimizer
+        self.communicator = comm
+
+    def init(self, params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return _DoubleBufferState(
+            inner=self.actual_optimizer.init(params),
+            pending=zeros,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, grads, state, params=None, **kwargs):
+        comm_grads = self.communicator.allreduce_grad(state.pending)
+        updates, inner = self.actual_optimizer.update(
+            comm_grads, state.inner, params, **kwargs)
+        new_state = _DoubleBufferState(
+            inner=inner, pending=grads, step=state.step + 1)
+        return updates, new_state
+
+    def state_partition_spec(self):
+        # ``pending`` holds per-device local grads — varying across the data
+        # axes; inner state and counter are replicated.
+        return _DoubleBufferState(
+            inner=P(), pending=_VARYING, step=P())
+
+
+# Sentinel replaced by the communicator's data axes in make_train_step.
+_VARYING = "__varying__"
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator,
+    double_buffering: bool = False,
+):
+    """Reference signature: ``create_multi_node_optimizer(optimizer, comm,
+    double_buffering)`` 〔optimizers.py〕.  ``actual_optimizer`` is an optax
+    GradientTransformation (the Chainer-optimizer role)."""
+    if double_buffering:
+        return _DoubleBufferingOptimizer(actual_optimizer, communicator)
+    return _MultiNodeOptimizer(actual_optimizer, communicator)
+
+
+def _resolve_spec(spec_tree, axes):
+    is_sentinel = lambda s: isinstance(s, str) and s == _VARYING
+    return jax.tree.map(
+        lambda s: P(axes) if is_sentinel(s) else s,
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, (P, str)),
+    )
+
+
+def make_train_step(
+    communicator,
+    loss_fn: Callable,
+    optimizer,
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """Build the canonical jitted SPMD train step (the hot loop of SURVEY.md
+    §3.2): per-device forward/backward on the local batch shard -> explicit
+    ``allreduce_grad`` -> inner optimizer update, all in one XLA program.
+
+    ``loss_fn(params, batch)`` sees the *local* batch shard, exactly like a
+    reference rank saw its local minibatch.  Returns
+    ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``
+    where ``batch`` leaves are sharded on their leading axis across the
+    communicator's data axes.
+    """
+    comm = communicator
+    axes = comm.data_axes
+    state_spec = _resolve_spec(
+        optimizer.state_partition_spec()
+        if hasattr(optimizer, "state_partition_spec") else P(), axes)
+
+    def step(params, opt_state, batch):
+        if isinstance(opt_state, _DoubleBufferState):
+            # The stacked pending buffer arrives as per-device [1, ...]
+            # slices; inside the SPMD body it is this rank's local grads.
+            opt_state = opt_state._replace(
+                pending=jax.tree.map(lambda a: jnp.squeeze(a, 0),
+                                     opt_state.pending))
+        # Mark the replicated params device-varying for the local backward:
+        # otherwise shard_map's autodiff inserts an automatic psum when
+        # differentiating the per-device loss w.r.t. invariant params, and
+        # gradients would arrive pre-summed — the explicit allreduce below
+        # (the reference's semantics) must be the only cross-device reduction.
+        params_local = jax.tree.map(lambda p: jax.lax.pvary(p, axes), params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if has_aux:
+            (loss, aux), grads = grad_fn(params_local, batch)
+        else:
+            loss, grads = grad_fn(params_local, batch)
+            aux = None
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if isinstance(opt_state, _DoubleBufferState):
+            opt_state = opt_state._replace(
+                pending=jax.tree.map(lambda a: a[None], opt_state.pending))
+        loss = comm.allreduce(loss, "mean")
+        if has_aux:
+            aux = comm.allreduce(aux, "mean")
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    out_specs = ((P(), state_spec, P(), P()) if has_aux
+                 else (P(), state_spec, P()))
+    mapped = jax.shard_map(
+        step,
+        mesh=comm.mesh,
+        in_specs=(P(), state_spec, P(axes)),
+        out_specs=out_specs,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def init_opt_state(communicator, optimizer, params):
+    """Initialize optimizer state with the right shardings: replicated inner
+    state; for double buffering, a stacked per-device ``pending`` buffer
+    (leading axis == communicator.size) sharded over the data axes."""
+    comm = communicator
+    state = optimizer.init(params)
+    if not isinstance(state, _DoubleBufferState):
+        return jax.device_put(state, NamedSharding(comm.mesh, P()))
+    stacked_pending = jax.tree.map(
+        lambda z: jnp.zeros((comm.size,) + z.shape, z.dtype), state.pending)
+    return _DoubleBufferState(
+        inner=jax.device_put(state.inner, NamedSharding(comm.mesh, P())),
+        pending=jax.device_put(
+            stacked_pending, NamedSharding(comm.mesh, P(comm.data_axes))),
+        step=jax.device_put(state.step, NamedSharding(comm.mesh, P())),
+    )

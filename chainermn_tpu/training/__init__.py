@@ -1,0 +1,4 @@
+from chainermn_tpu.training.trainer import StandardUpdater, Trainer
+from chainermn_tpu.training import extensions
+
+__all__ = ["StandardUpdater", "Trainer", "extensions"]

@@ -1,0 +1,152 @@
+"""Trainer extensions (the Chainer ``training.extensions`` role).
+
+The reference gates these to rank 0 in every example
+(``if comm.rank == 0: trainer.extend(...)`` — SURVEY.md §5.5); the same
+pattern applies here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+
+def _to_float(v):
+    try:
+        return float(np.asarray(v))
+    except Exception:
+        return v
+
+
+class LogReport:
+    """Aggregate per-iteration observations; emit one averaged record per
+    emit trigger.  Writes ``log`` (JSON) under ``trainer.out``.
+
+    Runs every iteration (it must see each observation); ``trigger`` here is
+    the *emit* cadence, mirroring Chainer's LogReport semantics.
+    """
+
+    priority = 50
+    name = "LogReport"
+    trigger = (1, "iteration")  # called every iteration; emits on _emit
+
+    def __init__(self, trigger=(1, "epoch"), filename: str = "log"):
+        self._emit = trigger
+        self._filename = filename
+        self._accum: dict = {}
+        self._counts: dict = {}
+        self.log: List[dict] = []
+
+    def __call__(self, trainer):
+        from chainermn_tpu.training.trainer import _trigger_fires
+
+        for k, v in trainer.observation.items():
+            # accumulate without converting: jax scalars stay on device so
+            # the hot loop never blocks on the just-dispatched step
+            self._accum[k] = (self._accum[k] + v) if k in self._accum else v
+            self._counts[k] = self._counts.get(k, 0) + 1
+        if not _trigger_fires(self._emit, trainer.updater):
+            return
+        record = {k: _to_float(self._accum[k]) / self._counts[k]
+                  for k in self._accum}
+        record.update({
+            "epoch": trainer.updater.epoch,
+            "iteration": trainer.updater.iteration,
+            "elapsed_time": trainer.elapsed_time,
+        })
+        self.log.append(record)
+        self._accum, self._counts = {}, {}
+        with open(os.path.join(trainer.out, self._filename), "w") as f:
+            json.dump(self.log, f, indent=1, default=float)
+
+
+class PrintReport:
+    priority = 40
+
+    def __init__(self, entries: List[str], log_report: str = "LogReport",
+                 out=sys.stdout):
+        self.trigger = (1, "epoch")
+        self._entries = entries
+        self._log_report = log_report
+        self._out = out
+        self._header_done = False
+
+    def __call__(self, trainer):
+        lr = trainer.get_extension(self._log_report)
+        if not lr.log:
+            return
+        rec = lr.log[-1]
+        if not self._header_done:
+            self._out.write("  ".join(f"{e:>16}" for e in self._entries) + "\n")
+            self._header_done = True
+        row = []
+        for e in self._entries:
+            v = rec.get(e, "")
+            row.append(f"{v:16.6g}" if isinstance(v, float) else f"{v!s:>16}")
+        self._out.write("  ".join(row) + "\n")
+        self._out.flush()
+
+
+class Evaluator:
+    """Run an eval function over a validation iterator; put mean metrics in
+    ``trainer.observation`` under ``validation/<key>``.
+
+    ``eval_fn(params, batch) -> dict`` should return *already
+    device-averaged* metrics (build it with the communicator's SPMD helpers
+    — see ``chainermn_tpu.extensions.create_multi_node_evaluator`` for the
+    cross-host aggregation wrapper, the reference's multi-node evaluator).
+    """
+
+    priority = 60
+    trigger = (1, "epoch")
+    name = "validation"
+
+    def __init__(self, iterator, eval_fn: Callable, comm,
+                 prefix: str = "validation"):
+        self.iterator = iterator
+        self.eval_fn = eval_fn
+        self.comm = comm
+        self.prefix = prefix
+
+    def evaluate(self, params) -> dict:
+        from chainermn_tpu.training.trainer import put_global_batch
+
+        totals: dict = {}
+        count = 0
+        self.iterator.reset()
+        for batch in self.iterator:
+            # wrap-pad the final partial batch so its leading dim divides the
+            # device count (same equal-length policy as scatter_dataset)
+            batch = put_global_batch(self.comm, batch, pad_to_multiple=True)
+            metrics = self.eval_fn(params, batch)
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + _to_float(v)
+            count += 1
+        return {k: v / max(count, 1) for k, v in totals.items()}
+
+    def __call__(self, trainer):
+        result = self.evaluate(trainer.updater.params)
+        trainer.observation.update(
+            {f"{self.prefix}/{k}": v for k, v in result.items()})
+
+
+class Snapshot:
+    """Periodic checkpoint via a checkpointer object (see
+    ``chainermn_tpu.extensions.checkpoint``)."""
+
+    priority = 30
+
+    def __init__(self, checkpointer, state_getter: Callable,
+                 trigger=(1, "epoch")):
+        self.trigger = trigger
+        self._ckpt = checkpointer
+        self._get = state_getter
+
+    def __call__(self, trainer):
+        self._ckpt.save(self._get(trainer), trainer.updater.iteration)

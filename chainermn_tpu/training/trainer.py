@@ -1,0 +1,163 @@
+"""Minimal trainer loop.
+
+In the reference, the training loop (``Trainer`` / ``StandardUpdater`` /
+trigger-driven extensions) is Chainer's — an *external* dependency that
+ChainerMN interposes on at three seams (SURVEY.md §1): the dataset (sharded),
+the optimizer (allreduce before update) and the extensions (rank-0 gating,
+metric aggregation).  This standalone rebuild supplies a compact equivalent
+so the same training-script shape works end to end:
+
+    updater = StandardUpdater(train_iter, step_fn, params, opt_state, comm)
+    trainer = Trainer(updater, (args.epoch, 'epoch'), out=args.out)
+    if comm.rank == 0:
+        trainer.extend(extensions.LogReport())
+    trainer.run()
+
+The hot loop stays one jitted SPMD step (built by
+``chainermn_tpu.optimizers.make_train_step``); everything here is per-epoch
+bookkeeping on the host.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _trigger_fires(trigger: Tuple[int, str], updater) -> bool:
+    n, unit = trigger
+    if unit == "iteration":
+        return updater.iteration % n == 0
+    if unit == "epoch":
+        return updater.is_new_epoch and updater.epoch % n == 0
+    raise ValueError(f"unknown trigger unit {unit!r}")
+
+
+def put_global_batch(comm, batch, pad_to_multiple: bool = False):
+    """Assemble each host's local examples into the global device-sharded
+    batch (single-host: a plain sharded device_put).
+
+    ``pad_to_multiple`` wrap-pads the leading axis up to a multiple of the
+    device count — needed for the final partial batch of a non-repeating
+    (evaluation) iterator, mirroring ``scatter_dataset``'s equal-length
+    padding semantics.
+    """
+    sharding = NamedSharding(comm.mesh, P(comm.data_axes))
+
+    def put(a):
+        a = np.asarray(a)
+        if pad_to_multiple:
+            # local leading dim must divide the per-host device share
+            local_share = comm.size // comm.host_size
+            n = a.shape[0]
+            m = -(-n // local_share) * local_share
+            if m != n:
+                idx = np.resize(np.arange(n), m)
+                a = a[idx]
+        return jax.make_array_from_process_local_data(sharding, a)
+
+    return jax.tree.map(put, batch)
+
+
+class StandardUpdater:
+    """Pulls a batch, shards it over the mesh, runs the jitted train step.
+
+    ``step_fn(params, opt_state, batch) -> (params, opt_state, loss[, aux])``
+    — typically from :func:`chainermn_tpu.optimizers.make_train_step`.
+    ``aux``, when present, must be a dict of scalars; it lands in the
+    per-iteration observation as ``main/<key>``.
+    """
+
+    def __init__(self, iterator, step_fn: Callable, params, opt_state, comm,
+                 convert_batch: Optional[Callable] = None):
+        self.iterator = iterator
+        self.step_fn = step_fn
+        self.params = params
+        self.opt_state = opt_state
+        self.comm = comm
+        self._convert = convert_batch
+        self._batch_sharding = NamedSharding(comm.mesh, P(comm.data_axes))
+        self.iteration = 0
+
+    @property
+    def epoch(self):
+        return self.iterator.epoch
+
+    @property
+    def is_new_epoch(self):
+        return self.iterator.is_new_epoch
+
+    @property
+    def epoch_detail(self):
+        return self.iterator.epoch_detail
+
+    def _put(self, batch):
+        if self._convert is not None:
+            batch = self._convert(batch)
+        return put_global_batch(self.comm, batch)
+
+    def update(self) -> dict:
+        batch = self._put(self.iterator.next())
+        out = self.step_fn(self.params, self.opt_state, batch)
+        self.params, self.opt_state = out[0], out[1]
+        self.iteration += 1
+        obs = {"main/loss": out[2]}
+        if len(out) > 3 and out[3] is not None:
+            obs.update({f"main/{k}": v for k, v in out[3].items()})
+        return obs
+
+
+class Trainer:
+    """Trigger-driven training loop (the Chainer ``Trainer`` role)."""
+
+    def __init__(self, updater, stop_trigger: Tuple[int, str] = (20, "epoch"),
+                 out: str = "result"):
+        self.updater = updater
+        self.stop_trigger = stop_trigger
+        self.out = out
+        self.observation: dict = {}
+        self._extensions = []  # (name, ext, trigger, priority)
+        self.elapsed_time = 0.0
+
+    def extend(self, extension, trigger: Optional[Tuple[int, str]] = None,
+               name: Optional[str] = None, priority: Optional[int] = None):
+        trigger = trigger or getattr(extension, "trigger", (1, "epoch"))
+        priority = priority if priority is not None else getattr(
+            extension, "priority", 100)
+        name = name or getattr(extension, "name", None) or type(extension).__name__
+        self._extensions.append((name, extension, trigger, priority))
+        self._extensions.sort(key=lambda t: -t[3])
+
+    def get_extension(self, name: str):
+        for n, ext, _, _ in self._extensions:
+            if n == name:
+                return ext
+        raise KeyError(name)
+
+    def _stop(self) -> bool:
+        n, unit = self.stop_trigger
+        if unit == "epoch":
+            return self.updater.epoch >= n
+        return self.updater.iteration >= n
+
+    def run(self):
+        os.makedirs(self.out, exist_ok=True)
+        start = time.time()
+        for _, ext, _, _ in self._extensions:
+            if hasattr(ext, "initialize"):
+                ext.initialize(self)
+        while not self._stop():
+            self.observation = self.updater.update()
+            self.elapsed_time = time.time() - start
+            for _, ext, trigger, _ in self._extensions:
+                if _trigger_fires(trigger, self.updater):
+                    ext(self)
+        for _, ext, _, _ in self._extensions:
+            if hasattr(ext, "finalize"):
+                ext.finalize(self)

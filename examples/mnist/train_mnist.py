@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Data-parallel MNIST training.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔examples/mnist/train_mnist.py〕 — the canonical ChainerMN smoke test
+(BASELINE.json configs[0]): create a communicator, scatter the dataset,
+wrap the optimizer, gate reporting extensions to rank 0, train an MLP.
+
+TPU-native differences: no ``mpiexec`` — run it once per host (or once,
+single-controller, driving the whole slice); topology comes from the device
+list.  MNIST itself needs a download, so without ``--data`` a synthetic
+Gaussian-blob set with MNIST shapes is used (convergence is still real).
+
+    python examples/mnist/train_mnist.py --communicator hierarchical --epoch 5
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.datasets import make_classification, TupleDataset
+from chainermn_tpu.extensions import create_multi_node_evaluator, make_eval_fn
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP
+from chainermn_tpu.optimizers import init_opt_state, make_train_step
+from chainermn_tpu.training import StandardUpdater, Trainer, extensions
+
+
+def load_data(args):
+    if args.data:
+        with np.load(args.data) as d:  # expects x_train/y_train/x_test/y_test
+            train = TupleDataset(d["x_train"].astype(np.float32),
+                                 d["y_train"].astype(np.int32))
+            test = TupleDataset(d["x_test"].astype(np.float32),
+                                d["y_test"].astype(np.int32))
+        return train, test
+    train = make_classification(n=12000, dim=784, n_classes=10,
+                                noise=4.0, seed=0)
+    test = make_classification(n=2000, dim=784, n_classes=10,
+                               noise=4.0, seed=1)
+    return train, test
+
+
+def main():
+    parser = argparse.ArgumentParser(description="chainermn_tpu MNIST example")
+    parser.add_argument("--batchsize", "-b", type=int, default=100,
+                        help="per-device minibatch size (reference: per-GPU)")
+    parser.add_argument("--communicator", type=str, default="hierarchical",
+                        help="naive/flat/hierarchical/two_dimensional/"
+                             "single_node/non_cuda_aware/xla/pure_nccl")
+    parser.add_argument("--epoch", "-e", type=int, default=20)
+    parser.add_argument("--unit", "-u", type=int, default=1000)
+    parser.add_argument("--out", "-o", default="result")
+    parser.add_argument("--data", default=None, help="npz with MNIST arrays")
+    parser.add_argument("--double-buffering", action="store_true",
+                        help="overlap gradient allreduce with compute "
+                             "(1-step-stale gradients)")
+    parser.add_argument("--allreduce-grad-dtype", default=None,
+                        help="communication dtype (xla communicator only), "
+                             "e.g. bfloat16")
+    parser.add_argument("--intra-size", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    comm = chainermn_tpu.create_communicator(
+        args.communicator, intra_size=args.intra_size,
+        allreduce_grad_dtype=args.allreduce_grad_dtype)
+
+    if comm.rank == 0:
+        print("==========================================")
+        print(f"Num devices: {comm.size} (inter {comm.inter_size} x "
+              f"intra {comm.intra_size}), hosts: {comm.host_size}")
+        print(f"Using {args.communicator} communicator")
+        print(f"Num units: {args.unit}, minibatch/device: {args.batchsize}, "
+              f"epochs: {args.epoch}")
+        if args.double_buffering:
+            print("Using double buffering (1-step-stale gradients)")
+        print("==========================================")
+
+    model = MLP(args.unit, 10)
+    rng = jax.random.key(args.seed)
+    params = model.init(rng, jnp.zeros((1, 784)))
+    params = comm.bcast_data(params)  # identical start everywhere
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm, double_buffering=args.double_buffering)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return loss, {"accuracy": acc}
+
+    step = make_train_step(comm, loss_fn, optimizer, has_aux=True)
+
+    train, test = load_data(args)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
+                                          seed=args.seed)
+    test = chainermn_tpu.scatter_dataset(test, comm, shuffle=False)
+
+    # reference batchsize is per-rank(GPU); the global batch is size x that,
+    # and each host's iterator supplies its share
+    local_bs = args.batchsize * comm.size // comm.host_size
+    train_iter = SerialIterator(train, local_bs, shuffle=True, seed=args.seed)
+    test_iter = SerialIterator(test, local_bs, repeat=False, shuffle=False)
+
+    updater = StandardUpdater(train_iter, step, params, opt_state, comm)
+    trainer = Trainer(updater, (args.epoch, "epoch"), out=args.out)
+
+    def metrics_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return {
+            "loss": optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(),
+            "accuracy": (logits.argmax(-1) == y).mean(),
+        }
+
+    evaluator = extensions.Evaluator(
+        test_iter, make_eval_fn(comm, metrics_fn), comm)
+    evaluator = create_multi_node_evaluator(evaluator, comm)
+    trainer.extend(evaluator, trigger=(1, "epoch"))
+
+    # reporting is gated to rank 0, exactly like the reference example
+    if comm.rank == 0:
+        trainer.extend(extensions.LogReport())
+        trainer.extend(extensions.PrintReport(
+            ["epoch", "main/loss", "validation/loss",
+             "main/accuracy", "validation/accuracy", "elapsed_time"]))
+
+    trainer.run()
+    if comm.rank == 0:
+        lr = trainer.get_extension("LogReport")
+        final = lr.log[-1] if lr.log else {}
+        print(f"final: {final}")
+
+
+if __name__ == "__main__":
+    main()
