@@ -1,0 +1,27 @@
+from chainermn_tpu.functions.point_to_point_communication import (
+    send,
+    recv,
+    pseudo_connect,
+    spmd_send_recv,
+)
+from chainermn_tpu.functions.collective_communication import (
+    allgather,
+    alltoall,
+    bcast,
+    gather,
+    scatter,
+    allreduce,
+)
+
+__all__ = [
+    "send",
+    "recv",
+    "pseudo_connect",
+    "spmd_send_recv",
+    "allgather",
+    "alltoall",
+    "bcast",
+    "gather",
+    "scatter",
+    "allreduce",
+]

@@ -1,0 +1,58 @@
+"""Differentiable collective communication.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔chainermn/functions/collective_communication.py〕 — ``AllGather``,
+``AllToAll``, ``Bcast``, ``Gather``, ``Scatter`` as Chainer Functions whose
+backwards are the *transposed collectives* (alltoall <-> alltoall, gather <->
+scatter, bcast <-> reduce).
+
+TPU-native version: these are thin wrappers over the communicator's traced
+collectives — JAX already knows the transpose of every XLA collective
+(``all_gather``'s transpose is ``psum_scatter``, ``all_to_all``'s is itself
+with swapped axes, ``psum``'s is broadcast), so the reference's hand-written
+backward classes collapse into the wrappers below.  They must be called
+inside an SPMD region (``comm.run_spmd`` / shard_map over the comm's mesh),
+where each device is one reference rank.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def allgather(communicator, x):
+    """Gather every rank's ``x`` onto all ranks -> stacked [size, ...].
+    Backward: each rank gets the summed slice of the cotangent that
+    corresponds to its contribution (reduce-scatter — automatic)."""
+    return communicator.allgather(x)
+
+
+def alltoall(communicator, xs):
+    """Transposed exchange of per-peer slots (leading axis == size).
+    Backward: alltoall again (its own transpose — automatic)."""
+    return communicator.alltoall(xs)
+
+
+def bcast(communicator, x, root: int = 0):
+    """Broadcast ``x`` from ``root``.  Backward: the cotangents from all
+    ranks are summed onto ``root`` (bcast <-> reduce — automatic)."""
+    return communicator.bcast(x, root=root)
+
+
+def gather(communicator, x, root: int = 0):
+    """Gather onto ``root`` (SPMD: materialized everywhere; see the
+    communicator's note).  Backward: scatter of the cotangent."""
+    return communicator.gather(x, root=root)
+
+
+def scatter(communicator, x, root: int = 0):
+    """Each rank takes its slice of root's stacked [size, ...] value.
+    Backward: gather of the cotangents."""
+    return communicator.scatter(x, root=root)
+
+
+def allreduce(communicator, x, op: str = "sum"):
+    """Allreduce with differentiable semantics (psum's transpose is the
+    identity broadcast of the cotangent to every rank)."""
+    return communicator.allreduce(x, op=op)

@@ -1,0 +1,140 @@
+"""Differentiable point-to-point communication.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔chainermn/functions/point_to_point_communication.py〕 — ``Send``/``Recv``
+Chainer Functions plus ``send()``, ``recv()``, ``pseudo_connect()``:
+``Send.forward`` ships an array to another rank and returns a tiny *delegate
+variable* so backward can reach the send; ``Send.backward`` receives the
+gradient back; ``Recv`` mirrors; ``pseudo_connect`` splices a delegate into
+the local graph so a single ``backward()`` drives the whole multi-process
+graph (SURVEY.md §3.5, hard part 2).
+
+TPU-native re-interpretation.  In the single-controller world the "ranks" of
+a model-parallel program are *device groups of one mesh*, and the entire
+multi-stage computation is one traced (or eagerly traced-through) function —
+so the backward of a send does not need a hand-rolled reverse message: it is
+the autodiff transpose of the device transfer, which JAX derives.  What
+remains of the reference machinery, and is kept API-compatible:
+
+* ``send(x, comm, rank)`` records ``x`` into the communicator's in-flight
+  channel and returns a **delegate** (a zero-sized array data-dependent on
+  ``x``) — the sequencing token the reference used;
+* ``recv(comm, rank, delegate_variable=...)`` pops the channel and *places*
+  the value on the receiving rank's devices (``jax.device_put`` — this is
+  the actual ICI transfer, and it is differentiable: its transpose moves the
+  cotangent back);
+* ``pseudo_connect(delegate, var)`` makes ``var`` data-dependent on the
+  delegate, preserving execution ordering across otherwise-disconnected
+  subgraphs.
+
+For peers living on one mesh *inside* an SPMD region, :func:`spmd_send_recv`
+provides the ``lax.ppermute`` path (a true chip-to-chip ICI transfer whose
+transpose is the reverse permutation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _ChannelState:
+    """In-flight sends keyed by (src, dst, tag).  Lives on the communicator;
+    purely trace-time bookkeeping (values are traced arrays)."""
+
+    def __init__(self):
+        self.slots = {}
+
+    def put(self, key, value):
+        self.slots.setdefault(key, []).append(value)
+
+    def pop(self, key):
+        q = self.slots.get(key)
+        if not q:
+            raise RuntimeError(
+                f"recv before matching send for channel {key}; model-parallel "
+                "stages must send before the consumer stage runs")
+        return q.pop(0)
+
+
+def _channels(comm) -> _ChannelState:
+    ch = getattr(comm, "_p2p_channels", None)
+    if ch is None:
+        ch = _ChannelState()
+        comm._p2p_channels = ch
+    return ch
+
+
+def _delegate_of(x) -> jnp.ndarray:
+    """A zero-sized array that is data-dependent on every leaf of ``x`` —
+    the reference's delegate variable."""
+    leaves = jax.tree.leaves(x)
+    acc = jnp.zeros((1,), jnp.float32)
+    for leaf in leaves:
+        acc = acc + jnp.sum(leaf).astype(jnp.float32) * 0.0
+    return acc[:0]  # shape (0,): carries dependency, no data
+
+
+def send(x, communicator, rank: int, tag: int = 0,
+         self_rank: Optional[int] = None):
+    """Ship ``x`` toward model-parallel rank ``rank``.
+
+    Reference: ``chainermn.functions.send(x, comm, rank)`` — returns the
+    delegate variable to thread into ``pseudo_connect``.
+    """
+    src = self_rank if self_rank is not None else getattr(
+        communicator, "_mp_rank", 0)
+    _channels(communicator).put((src, rank, tag), x)
+    return _delegate_of(x)
+
+
+def recv(communicator, rank: int, delegate_variable=None, tag: int = 0,
+         self_rank: Optional[int] = None, device_put=None):
+    """Receive the value sent by model-parallel rank ``rank``.
+
+    Reference: ``chainermn.functions.recv(comm, rank, delegate_variable)``.
+    ``device_put`` (a function ``x -> x`` applying the destination sharding)
+    performs the actual inter-group transfer; ``MultiNodeChainList`` passes
+    the receiving stage's placement.  The transfer is differentiable — its
+    transpose returns the cotangent to the sender's devices, which is the
+    reference's ``Recv.backward -> comm.send(grad)`` with no hand-written
+    reverse path.
+    """
+    dst = self_rank if self_rank is not None else getattr(
+        communicator, "_mp_rank", 0)
+    x = _channels(communicator).pop((rank, dst, tag))
+    if device_put is not None:
+        x = device_put(x)
+    if delegate_variable is not None:
+        x = pseudo_connect(delegate_variable, x)
+    return x
+
+
+def pseudo_connect(delegate_variable, *actual_vars):
+    """Make ``actual_vars`` data-dependent on ``delegate_variable``.
+
+    Reference: ``chainermn.functions.pseudo_connect`` — splices a delegate
+    into the local graph so one ``backward()`` reaches sends on other ranks.
+    Here the dependency is expressed with a zero-valued add (elided by XLA,
+    preserved by autodiff).
+    """
+    pad = jnp.sum(jnp.concatenate(
+        [delegate_variable.astype(jnp.float32),
+         jnp.zeros((1,), jnp.float32)]))  # scalar 0 depending on delegate
+
+    def tie(v):
+        return v + pad.astype(v.dtype) if jnp.issubdtype(
+            jnp.asarray(v).dtype, jnp.inexact) else v
+
+    out = tuple(jax.tree.map(tie, v) for v in actual_vars)
+    return out[0] if len(out) == 1 else out
+
+
+def spmd_send_recv(x, communicator, pairs: List[Tuple[int, int]]):
+    """Device-level p2p inside an SPMD region: ship per-device values along
+    ``pairs`` (src, dst) with ``lax.ppermute``.  Devices not named in
+    ``pairs`` receive zeros — the collective-permute semantics native to the
+    ICI torus.  Differentiable (transpose = reversed permutation)."""
+    return communicator.ppermute(x, pairs)

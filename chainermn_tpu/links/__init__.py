@@ -1,0 +1,3 @@
+from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+
+__all__ = ["MultiNodeChainList"]

@@ -1,0 +1,59 @@
+"""Encoder/decoder sequence-to-sequence model, split for model parallelism.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔examples/seq2seq/seq2seq.py〕 — an NStepLSTM encoder on one rank and
+decoder on another, wired through ``MultiNodeChainList``/send-recv
+(BASELINE.json configs[3]).  Rebuilt as two flax modules whose cross-stage
+interface is the LSTM carry pytree — exactly the tensor the reference
+shipped between ranks.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class Seq2SeqEncoder(nn.Module):
+    """Embed + LSTM; returns the final carry (the cross-rank tensor)."""
+
+    vocab_size: int
+    embed_dim: int = 64
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, src):
+        emb = nn.Embed(self.vocab_size, self.embed_dim)(src)
+        carry, _ = nn.RNN(nn.OptimizedLSTMCell(self.hidden),
+                          return_carry=True)(emb)
+        return carry  # (c, h) pytree -> sent to the decoder's rank
+
+
+class Seq2SeqDecoder(nn.Module):
+    """Teacher-forced LSTM decoder seeded with the encoder carry."""
+
+    vocab_size: int
+    embed_dim: int = 64
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, enc_carry, tgt_in):
+        emb = nn.Embed(self.vocab_size, self.embed_dim)(tgt_in)
+        outs = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(
+            emb, initial_carry=enc_carry)
+        return nn.Dense(self.vocab_size)(outs)
+
+
+def make_copy_reverse_task(n: int, seq_len: int, vocab: int, seed: int = 0):
+    """Synthetic translation stand-in: target = reversed source.  BOS token
+    is id 1; ids 2.. are symbols; 0 is pad (unused — fixed lengths keep XLA
+    shapes static)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    src = rng.randint(2, vocab, size=(n, seq_len)).astype(np.int32)
+    tgt = src[:, ::-1].copy()
+    bos = np.ones((n, 1), np.int32)
+    tgt_in = np.concatenate([bos, tgt[:, :-1]], axis=1)
+    return src, tgt_in, tgt

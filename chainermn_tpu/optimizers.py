@@ -191,6 +191,42 @@ def make_train_step(
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
+class PerStageOptimizer:
+    """Optimizer for model-parallel parameter lists (``MultiNodeChainList``):
+    one optax state per stage, each update jitted on that stage's devices.
+
+    A single optax update over the whole list would jit one computation over
+    leaves committed to disjoint device groups, which XLA rejects; stage-wise
+    application is also what the reference does (each rank updates only its
+    own sub-chain's parameters).
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation):
+        self.actual_optimizer = actual_optimizer
+        self._jit_update = jax.jit(actual_optimizer.update)
+        self._jit_apply = jax.jit(optax.apply_updates)
+
+    def init(self, params_list):
+        return [self.actual_optimizer.init(p) for p in params_list]
+
+    def update(self, grads_list, states, params_list):
+        if not (len(grads_list) == len(states) == len(params_list)):
+            raise ValueError(
+                f"stage count mismatch: {len(grads_list)} grads, "
+                f"{len(states)} states, {len(params_list)} params — "
+                "re-init the optimizer after changing the chain list")
+        new_params, new_states = [], []
+        for g, s, p in zip(grads_list, states, params_list):
+            updates, s2 = self._jit_update(g, s, p)
+            new_params.append(self._jit_apply(p, updates))
+            new_states.append(s2)
+        return new_params, new_states
+
+
+def create_per_stage_optimizer(actual_optimizer: optax.GradientTransformation):
+    return PerStageOptimizer(actual_optimizer)
+
+
 def init_opt_state(communicator, optimizer, params):
     """Initialize optimizer state with the right shardings: replicated inner
     state; for double buffering, a stacked per-device ``pending`` buffer

@@ -1,0 +1,175 @@
+"""MultiNodeChainList tests.
+
+Reference strategy (SURVEY.md §4): composed multi-rank model's forward and
+backward must match the single-process equivalent exactly.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.links import MultiNodeChainList
+
+
+class StageA(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.tanh(nn.Dense(16)(x))
+
+
+class StageB(nn.Module):
+    @nn.compact
+    def __call__(self, h):
+        return nn.Dense(4)(h)
+
+
+class TwoInputStage(nn.Module):
+    @nn.compact
+    def __call__(self, h, extra):
+        return nn.Dense(4)(h) + extra
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("xla", intra_size=4)
+
+
+def build_pipeline(comm):
+    m = MultiNodeChainList(comm)
+    m.add_link(StageA(), rank_in=None, rank_out=1)
+    m.add_link(StageB(), rank_in=0, rank_out=None)
+    return m
+
+
+class TestForward:
+    def test_matches_single_process(self, comm):
+        m = build_pipeline(comm)
+        x = jax.random.normal(jax.random.key(0), (8, 12))
+        params = m.init(jax.random.key(1), x)
+        y = m.apply(params, x)
+        assert y.shape == (8, 4)
+        # single-process equivalent with the same params (pulled to host:
+        # the live copies are committed to disjoint device groups)
+        host = jax.device_get(list(params))
+        a = StageA().apply(host[0], x)
+        b = StageB().apply(host[1], a)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(b), rtol=1e-5)
+
+    def test_stage_placement(self, comm):
+        m = build_pipeline(comm)
+        x = jnp.ones((8, 12))
+        params = m.init(jax.random.key(0), x)
+        dev0 = set(m.stage_devices(0))
+        dev1 = set(m.stage_devices(1))
+        assert dev0.isdisjoint(dev1)
+        assert len(dev0) == 4 and len(dev1) == 4
+        p0_devs = set(jax.tree.leaves(params[0])[0].sharding.device_set)
+        p1_devs = set(jax.tree.leaves(params[1])[0].sharding.device_set)
+        assert p0_devs == dev0
+        assert p1_devs == dev1
+
+    def test_multi_output(self, comm):
+        m = MultiNodeChainList(comm)
+        m.add_link(StageA(), rank_in=None, rank_out=[1, 2])
+        m.add_link(StageB(), rank_in=0, rank_out=None)
+        m.add_link(StageB(), rank_in=0, rank_out=None)
+        # 3 stages on 8 devices -> groups of 3/3/2; batch must divide each
+        x = jnp.ones((12, 12))
+        params = m.init(jax.random.key(0), x)
+        y1, y2 = m.apply(params, x)
+        assert y1.shape == (12, 4) and y2.shape == (12, 4)
+
+    def test_stage_extra_inputs(self, comm):
+        m = MultiNodeChainList(comm)
+        m.add_link(StageA(), rank_in=None, rank_out=1)
+        m.add_link(TwoInputStage(), rank_in=0, rank_out=None)
+        x = jnp.ones((4, 12))
+        extra = jnp.full((4, 4), 10.0)
+        params = m.init(jax.random.key(0), x, stage_inputs={1: (extra,)})
+        y = m.apply(params, x, stage_inputs={1: (extra,)})
+        host = jax.device_get(list(params))
+        a = StageA().apply(host[0], x)
+        ref = TwoInputStage().apply(host[1], a, extra)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5)
+
+
+class TestBackward:
+    def test_grads_match_single_process(self, comm):
+        """One backward spans both stages (the reference's pseudo_connect
+        choreography); grads must equal the unsplit model's."""
+        m = build_pipeline(comm)
+        x = jax.random.normal(jax.random.key(0), (8, 12))
+        t = jax.random.normal(jax.random.key(1), (8, 4))
+        params = m.init(jax.random.key(2), x)
+
+        def split_loss(ps):
+            y = m.apply(ps, x)
+            return jnp.mean((y - t) ** 2)
+
+        def local_loss(ps):
+            a = StageA().apply(ps[0], x)
+            y = StageB().apply(ps[1], a)
+            return jnp.mean((y - t) ** 2)
+
+        g_split = jax.grad(split_loss)(params)
+        g_local = jax.grad(local_loss)(jax.device_get(list(params)))
+        for gs, gl in zip(jax.tree.leaves(g_split), jax.tree.leaves(g_local)):
+            np.testing.assert_allclose(np.asarray(gs), np.asarray(gl),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_training_through_pipeline(self, comm):
+        m = build_pipeline(comm)
+        x = jax.random.normal(jax.random.key(0), (32, 12))
+        w = jax.random.normal(jax.random.key(1), (12, 4))
+        t = jnp.tanh(x) @ w
+        params = m.init(jax.random.key(2), x)
+        from chainermn_tpu.optimizers import create_per_stage_optimizer
+        opt = create_per_stage_optimizer(optax.adam(1e-2))
+        opt_state = opt.init(params)
+
+        def loss_fn(ps):
+            return jnp.mean((m.apply(ps, x) - t) ** 2)
+
+        losses = []
+        for _ in range(60):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, opt_state = opt.update(grads, opt_state, params)
+            losses.append(float(loss))
+        assert losses[-1] < 0.1 * losses[0]
+
+
+class TestSeq2Seq:
+    def test_cross_stage_carry_learns(self, comm):
+        from chainermn_tpu.models.seq2seq import (
+            Seq2SeqDecoder, Seq2SeqEncoder, make_copy_reverse_task)
+
+        vocab, L = 16, 6
+        m = MultiNodeChainList(comm)
+        m.add_link(Seq2SeqEncoder(vocab, embed_dim=16, hidden=32),
+                   rank_in=None, rank_out=1)
+        m.add_link(Seq2SeqDecoder(vocab, embed_dim=16, hidden=32),
+                   rank_in=0, rank_out=None)
+        src, tgt_in, tgt = make_copy_reverse_task(256, L, vocab)
+        params = m.init(jax.random.key(0), src[:32],
+                        stage_inputs={1: (tgt_in[:32],)})
+        from chainermn_tpu.optimizers import create_per_stage_optimizer
+        opt = create_per_stage_optimizer(optax.adam(1e-2))
+        opt_state = opt.init(params)
+
+        def loss_fn(ps, s, ti, t):
+            logits = m.apply(ps, s, stage_inputs={1: (ti,)})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, t).mean()
+
+        first = None
+        for i in range(60):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, src, tgt_in, tgt)
+            params, opt_state = opt.update(grads, opt_state, params)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < 0.5 * first
