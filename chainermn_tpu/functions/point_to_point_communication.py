@@ -119,6 +119,13 @@ def pseudo_connect(delegate_variable, *actual_vars):
     into the local graph so one ``backward()`` reaches sends on other ranks.
     Here the dependency is expressed with a zero-valued add (elided by XLA,
     preserved by autodiff).
+
+    Only *inexact* (float/complex) leaves are tied; integer/bool leaves pass
+    through unchanged, since adding a traced zero would not create a
+    differentiable dependency anyway (the reference has the same shape: its
+    delegate threading exists for the backward pass, which integer data does
+    not participate in).  A pytree with no inexact leaf gains no ordering
+    dependency from this call.
     """
     pad = jnp.sum(jnp.concatenate(
         [delegate_variable.astype(jnp.float32),

@@ -42,6 +42,9 @@ STAGE_DP_AXIS = "stage_dp"
 Ranks = Union[int, Sequence[int], None]
 
 
+_instance_counter = iter(range(1 << 30))
+
+
 class MultiNodeChainList:
     def __init__(self, comm, n_stages: Optional[int] = None):
         self._comm = comm
@@ -49,6 +52,10 @@ class MultiNodeChainList:
         self._n_stages_hint = n_stages
         self._stage_meshes: Optional[List[Mesh]] = None
         self._jits: dict = {}
+        # Private tag namespace: several chain lists (or user-level raw
+        # F.send/F.recv, which default to tag 0) may share one communicator;
+        # each instance's channels must neither collide with nor clear theirs.
+        self._tag = 1 + next(_instance_counter)
 
     # -- registration --------------------------------------------------------
     def add_link(self, module, rank_in: Ranks = None, rank_out: Ranks = None):
@@ -132,8 +139,12 @@ class MultiNodeChainList:
 
         # Fresh composition: a previous apply() that raised mid-flight (or a
         # mis-wired graph) must not leak stale activations into this one.
+        # Only THIS instance's tag namespace is cleared — other chain lists'
+        # and user-level raw send/recv channels on the same communicator are
+        # not ours to destroy.
         channels = _channels(self._comm)
-        channels.slots.clear()
+        for k in [k for k in channels.slots if k[2] == self._tag]:
+            del channels.slots[k]
 
         # Input routing mirrors the reference's MPMD shape: with one entry
         # stage (rank_in=None) it receives all model inputs; with several,
@@ -159,7 +170,7 @@ class MultiNodeChainList:
                 ranks = rank_in if isinstance(rank_in, (list, tuple)) else [rank_in]
                 for r in ranks:
                     received.append(F.recv(
-                        self._comm, r, self_rank=s,
+                        self._comm, r, self_rank=s, tag=self._tag,
                         device_put=lambda v, _s=s: self._place_act(v, _s)))
             received.extend(stage_inputs.get(s, ()))
             args = tuple(received)
@@ -171,8 +182,9 @@ class MultiNodeChainList:
             else:
                 ranks = rank_out if isinstance(rank_out, (list, tuple)) else [rank_out]
                 for r in ranks:
-                    F.send(y, self._comm, r, self_rank=s)
-        leftovers = [k for k, q in channels.slots.items() if q]
+                    F.send(y, self._comm, r, self_rank=s, tag=self._tag)
+        leftovers = [k for k, q in channels.slots.items()
+                     if q and k[2] == self._tag]
         if leftovers:
             raise RuntimeError(
                 f"unconsumed sends on channels {leftovers}: some rank_out "

@@ -1,3 +1,33 @@
+from chainermn_tpu.models.alexnet import AlexNet
+from chainermn_tpu.models.googlenet import GoogLeNet, GoogLeNetBN
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.nin import NIN
+from chainermn_tpu.models.resnet import (
+    BasicBlock,
+    BottleneckBlock,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from chainermn_tpu.models.vgg import VGG, VGG16
 
-__all__ = ["MLP"]
+__all__ = [
+    "MLP",
+    "AlexNet",
+    "NIN",
+    "GoogLeNet",
+    "GoogLeNetBN",
+    "BasicBlock",
+    "BottleneckBlock",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "VGG",
+    "VGG16",
+]

@@ -1,0 +1,61 @@
+"""AlexNet — one of the reference ImageNet example's architectures.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔examples/imagenet/models/alex.py〕 — Chainer's AlexNet variant used in the
+ImageNet example (conv5 + fc3, local response normalization after the first
+two conv stages, dropout on the fc head).
+
+NHWC / bf16-capable, same conventions as :mod:`.resnet`.  LRN is implemented
+inline (XLA fuses the window sum); AlexNet has no BatchNorm, so it carries
+no ``batch_stats`` — train it with ``make_train_step(with_model_state=False)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def local_response_normalization(x, n: int = 5, k: float = 2.0,
+                                 alpha: float = 1e-4, beta: float = 0.75):
+    """Krizhevsky-style LRN over the channel axis (NHWC)."""
+    sq = jnp.square(x.astype(jnp.float32))
+    c = x.shape[-1]
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    windows = jnp.stack([pad[..., i:i + c] for i in range(n)], axis=0)
+    denom = (k + alpha * windows.sum(axis=0)) ** beta
+    return (x.astype(jnp.float32) / denom).astype(x.dtype)
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        dense = lambda n: nn.Dense(n, dtype=self.dtype,
+                                   param_dtype=jnp.float32)
+        conv = lambda f, k, s=(1, 1): nn.Conv(
+            f, k, s, padding="SAME", dtype=self.dtype,
+            param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(96, (11, 11), (4, 4))(x))
+        x = local_response_normalization(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(256, (5, 5))(x))
+        x = local_response_normalization(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3))(x))
+        x = nn.relu(conv(384, (3, 3))(x))
+        x = nn.relu(conv(256, (3, 3))(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(dense(4096)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return dense(self.num_classes)(x).astype(jnp.float32)

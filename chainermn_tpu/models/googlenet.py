@@ -1,0 +1,121 @@
+"""GoogLeNet (Inception v1) and its BatchNorm variant.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔examples/imagenet/models/googlenet.py〕 and
+〔examples/imagenet/models/googlenetbn.py〕 — the two Inception
+architectures in the reference's ImageNet example.  The BN variant follows
+the inception-BN recipe (BN after every conv, 3x3 factorization of the 5x5
+tower); the plain variant matches Szegedy et al.'s v1 towers.  Auxiliary
+classifier heads are omitted (the reference example trains with the main
+head's loss; the aux heads exist upstream for the paper recipe but are not
+needed for throughput or convergence parity at this scale).
+
+NHWC / bf16-capable.  ``GoogLeNetBN`` carries ``batch_stats`` (local-BN,
+same semantics as :mod:`.resnet`); plain ``GoogLeNet`` does not.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InceptionBlock(nn.Module):
+    """Parallel 1x1 / 3x3 / 5x5 / pool-proj towers, channel-concatenated."""
+
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+    use_bn: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, padding="SAME", dtype=self.dtype,
+                       param_dtype=jnp.float32, use_bias=not self.use_bn)
+        def unit(y, f, k):
+            y = conv(f, k)(y)
+            if self.use_bn:
+                y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype,
+                                 param_dtype=jnp.float32)(y)
+            return nn.relu(y)
+
+        t1 = unit(x, self.c1, (1, 1))
+        t3 = unit(unit(x, self.c3r, (1, 1)), self.c3, (3, 3))
+        if self.use_bn:
+            # inception-BN factorizes the 5x5 tower into two 3x3 convs
+            t5 = unit(x, self.c5r, (1, 1))
+            t5 = unit(t5, self.c5, (3, 3))
+            t5 = unit(t5, self.c5, (3, 3))
+        else:
+            t5 = unit(unit(x, self.c5r, (1, 1)), self.c5, (5, 5))
+        tp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        tp = unit(tp, self.cp, (1, 1))
+        return jnp.concatenate([t1, t3, t5, tp], axis=-1)
+
+
+# (c1, c3r, c3, c5r, c5, cp) per inception block, Szegedy et al. table 1.
+_BLOCKS = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    use_bn: bool = False
+    dropout_rate: float = 0.4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, padding="SAME", dtype=self.dtype,
+                       param_dtype=jnp.float32, use_bias=not self.use_bn)
+
+        def unit(y, f, k, s=(1, 1)):
+            y = conv(f, k, s)(y)
+            if self.use_bn:
+                y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype,
+                                 param_dtype=jnp.float32)(y)
+            return nn.relu(y)
+
+        x = x.astype(self.dtype)
+        x = unit(x, 64, (7, 7), (2, 2))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = unit(x, 64, (1, 1))
+        x = unit(x, 192, (3, 3))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for name in ("3a", "3b"):
+            x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
+                               dtype=self.dtype, name=f"inc{name}")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for name in ("4a", "4b", "4c", "4d", "4e"):
+            x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
+                               dtype=self.dtype, name=f"inc{name}")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for name in ("5a", "5b"):
+            x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
+                               dtype=self.dtype, name=f"inc{name}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+GoogLeNetBN = partial(GoogLeNet, use_bn=True)

@@ -1,0 +1,43 @@
+"""Network-in-Network — one of the reference ImageNet example's architectures.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+〔examples/imagenet/models/nin.py〕 — Chainer's NIN: four "mlpconv" stacks
+(a spatial conv followed by two 1x1 convs), max-pooling between them, global
+average pooling over ``num_classes`` maps instead of a dense head.
+
+NHWC / bf16-capable; no BatchNorm, so no ``batch_stats``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class NIN(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    def _mlpconv(self, x, f, k, s):
+        conv = lambda ff, kk, ss=(1, 1): nn.Conv(
+            ff, kk, ss, padding="SAME", dtype=self.dtype,
+            param_dtype=jnp.float32)
+        x = nn.relu(conv(f, k, s)(x))
+        x = nn.relu(conv(f, (1, 1))(x))
+        return nn.relu(conv(f, (1, 1))(x))
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = self._mlpconv(x, 96, (11, 11), (4, 4))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 256, (5, 5), (1, 1))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 384, (3, 3), (1, 1))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = self._mlpconv(x, self.num_classes, (3, 3), (1, 1))
+        return jnp.mean(x, axis=(1, 2)).astype(jnp.float32)

@@ -1,0 +1,56 @@
+"""VGG-16 — the reference's CIFAR-scale convnet.
+
+Reference being rebuilt (SURVEY.md provenance / BASELINE.json configs[2]):
+the VGG-16/CIFAR-10 configuration used to validate the double-buffered
+allreduce optimizer.  Chainer-era VGG for CIFAR = conv-BN-ReLU stacks with
+max-pooling and a small classifier head.
+
+NHWC, bfloat16-capable (``dtype``), local-statistics BatchNorm in the
+``batch_stats`` collection — same conventions as :mod:`.resnet`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Channel plan per conv stage; 'M' = 2x2 max pool.  This is the standard
+# VGG-16 configuration ("D").
+_CFG_16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence = _CFG_16
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    hidden: int = 512
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), use_bias=False,
+                       dtype=self.dtype, param_dtype=jnp.float32,
+                       padding="SAME")
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for c in self.cfg:
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(norm()(conv(c)(x)))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = VGG

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from chainermn_tpu.utils import pvary
+
 _LANE = 128
 _BLOCK_ROWS = 256  # 256 x 128 f32 = 128 KiB per buffer; in+out fit VMEM easily
 
@@ -62,7 +64,7 @@ def cast_scale(x: jnp.ndarray, target_dtype: Optional[jnp.dtype], scale: float):
         z = jnp.zeros((k,), flat.dtype)
         if in_vma:
             # match the input's varying-axes set so concatenate is legal
-            z = jax.lax.pvary(z, tuple(in_vma))
+            z = pvary(z, tuple(in_vma))
         return z
 
     rows = -(-n // _LANE)
@@ -81,7 +83,7 @@ def cast_scale(x: jnp.ndarray, target_dtype: Optional[jnp.dtype], scale: float):
     vma = getattr(jax.typeof(x2), "vma", None)
     if vma is not None:
         if vma:
-            s_arr = jax.lax.pvary(s_arr, tuple(vma))
+            s_arr = pvary(s_arr, tuple(vma))
         out_sds = jax.ShapeDtypeStruct((padded_rows, _LANE), dst, vma=vma)
     else:
         out_sds = jax.ShapeDtypeStruct((padded_rows, _LANE), dst)

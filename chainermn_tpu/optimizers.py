@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from chainermn_tpu.utils import pvary
+
 
 class _MultiNodeOptimizer:
     """optax-compatible wrapper: allreduce-mean the grads, then inner update.
@@ -133,6 +135,7 @@ def make_train_step(
     optimizer,
     has_aux: bool = False,
     donate: bool = True,
+    with_model_state: bool = False,
 ):
     """Build the canonical jitted SPMD train step (the hot loop of SURVEY.md
     §3.2): per-device forward/backward on the local batch shard -> explicit
@@ -143,6 +146,17 @@ def make_train_step(
     ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``
     where ``batch`` leaves are sharded on their leading axis across the
     communicator's data axes.
+
+    ``with_model_state=True`` adds a non-trainable mutable model state slot
+    (flax ``batch_stats``) that stays **device-local** — the reference trains
+    BatchNorm on local statistics and only syncs via ``AllreducePersistent``
+    (SURVEY.md §7 hard part 5), so the state is carried stacked per-device
+    ([size, ...], sharded over the data axes; see :func:`init_model_state`)
+    and never reduced inside the step.  Signatures become
+    ``loss_fn(params, model_state, batch) -> (loss, new_state)`` (or
+    ``(loss, (new_state, aux))`` with ``has_aux``) and
+    ``step(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss[, aux])``.
     """
     comm = communicator
     axes = comm.data_axes
@@ -150,21 +164,27 @@ def make_train_step(
         optimizer.state_partition_spec()
         if hasattr(optimizer, "state_partition_spec") else P(), axes)
 
-    def step(params, opt_state, batch):
+    def step(params, model_state, opt_state, batch):
         if isinstance(opt_state, _DoubleBufferState):
             # The stacked pending buffer arrives as per-device [1, ...]
             # slices; inside the SPMD body it is this rank's local grads.
             opt_state = opt_state._replace(
                 pending=jax.tree.map(lambda a: jnp.squeeze(a, 0),
                                      opt_state.pending))
+        if with_model_state:
+            model_state = jax.tree.map(lambda a: jnp.squeeze(a, 0), model_state)
         # Mark the replicated params device-varying for the local backward:
         # otherwise shard_map's autodiff inserts an automatic psum when
         # differentiating the per-device loss w.r.t. invariant params, and
         # gradients would arrive pre-summed — the explicit allreduce below
         # (the reference's semantics) must be the only cross-device reduction.
-        params_local = jax.tree.map(lambda p: jax.lax.pvary(p, axes), params)
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-        if has_aux:
+        params_local = jax.tree.map(lambda p: pvary(p, axes), params)
+        grad_fn = jax.value_and_grad(
+            loss_fn, has_aux=has_aux or with_model_state)
+        if with_model_state:
+            (loss, packed), grads = grad_fn(params_local, model_state, batch)
+            model_state, aux = packed if has_aux else (packed, None)
+        elif has_aux:
             (loss, aux), grads = grad_fn(params_local, batch)
         else:
             loss, grads = grad_fn(params_local, batch)
@@ -174,21 +194,32 @@ def make_train_step(
         if isinstance(opt_state, _DoubleBufferState):
             opt_state = opt_state._replace(
                 pending=jax.tree.map(lambda a: a[None], opt_state.pending))
+        if with_model_state:
+            model_state = jax.tree.map(lambda a: a[None], model_state)
         loss = comm.allreduce(loss, "mean")
         if has_aux:
             aux = comm.allreduce(aux, "mean")
-            return params, opt_state, loss, aux
-        return params, opt_state, loss
+        outs = (params, model_state, opt_state, loss, aux)
+        keep = (True, with_model_state, True, True, has_aux)
+        return tuple(o for o, k in zip(outs, keep) if k)
 
-    out_specs = ((P(), state_spec, P(), P()) if has_aux
-                 else (P(), state_spec, P()))
+    out_spec_all = (P(), P(axes), state_spec, P(), P())
+    keep = (True, with_model_state, True, True, has_aux)
+    out_specs = tuple(s for s, k in zip(out_spec_all, keep) if k)
+    in_specs = ((P(), P(axes), state_spec, P(axes)) if with_model_state
+                else (P(), state_spec, P(axes)))
+    inner = step
+    if not with_model_state:
+        def inner(params, opt_state, batch):  # noqa: F811
+            return step(params, None, opt_state, batch)
     mapped = jax.shard_map(
-        step,
+        inner,
         mesh=comm.mesh,
-        in_specs=(P(), state_spec, P(axes)),
+        in_specs=in_specs,
         out_specs=out_specs,
     )
-    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    donate_argnums = ((0, 1, 2) if with_model_state else (0, 1)) if donate else ()
+    return jax.jit(mapped, donate_argnums=donate_argnums)
 
 
 class PerStageOptimizer:
@@ -225,6 +256,19 @@ class PerStageOptimizer:
 
 def create_per_stage_optimizer(actual_optimizer: optax.GradientTransformation):
     return PerStageOptimizer(actual_optimizer)
+
+
+def init_model_state(communicator, model_state):
+    """Stack per-device copies of initial mutable model state (``batch_stats``)
+    into the device-local layout ``make_train_step(with_model_state=True)``
+    expects: leading axis == communicator.size, sharded over the data axes.
+    Every device starts from the same (typically zero/one-initialized) stats,
+    then they drift apart — local BN, the reference's semantics."""
+    comm = communicator
+    stacked = jax.tree.map(
+        lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape), model_state)
+    return jax.device_put(
+        stacked, NamedSharding(comm.mesh, P(comm.data_axes)))
 
 
 def init_opt_state(communicator, optimizer, params):

@@ -1,4 +1,10 @@
-from chainermn_tpu.training.trainer import StandardUpdater, Trainer
+from chainermn_tpu.training.trainer import (
+    StandardUpdater,
+    StatefulUpdater,
+    Trainer,
+    put_global_batch,
+)
 from chainermn_tpu.training import extensions
 
-__all__ = ["StandardUpdater", "Trainer", "extensions"]
+__all__ = ["StandardUpdater", "StatefulUpdater", "Trainer", "extensions",
+           "put_global_batch"]

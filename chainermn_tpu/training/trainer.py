@@ -113,6 +113,33 @@ class StandardUpdater:
         return obs
 
 
+class StatefulUpdater(StandardUpdater):
+    """StandardUpdater + device-local mutable model state (flax
+    ``batch_stats`` under local-BN semantics — SURVEY.md §7 hard part 5).
+
+    ``step_fn(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss[, aux])`` — from
+    ``make_train_step(..., with_model_state=True)``.
+    """
+
+    def __init__(self, iterator, step_fn: Callable, params, model_state,
+                 opt_state, comm, convert_batch: Optional[Callable] = None):
+        super().__init__(iterator, step_fn, params, opt_state, comm,
+                         convert_batch)
+        self.model_state = model_state
+
+    def update(self) -> dict:
+        batch = self._put(self.iterator.next())
+        out = self.step_fn(self.params, self.model_state, self.opt_state,
+                           batch)
+        self.params, self.model_state, self.opt_state = out[0], out[1], out[2]
+        self.iteration += 1
+        obs = {"main/loss": out[3]}
+        if len(out) > 4 and out[4] is not None:
+            obs.update({f"main/{k}": v for k, v in out[4].items()})
+        return obs
+
+
 class Trainer:
     """Trigger-driven training loop (the Chainer ``Trainer`` role)."""
 

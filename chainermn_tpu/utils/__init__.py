@@ -1,0 +1,19 @@
+"""Small shared utilities (version compatibility, tree helpers)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pvary(x, axes):
+    """Mark ``x`` as varying over mesh ``axes`` inside shard_map.
+
+    ``jax.lax.pvary`` is deprecated in favor of ``jax.lax.pcast(..., to=
+    'varying')``; this shim targets whichever this jax version provides.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
+
+
+__all__ = ["pvary"]

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Data-parallel CIFAR-10 training with VGG-16 and double buffering.
+
+Reference being rebuilt (SURVEY.md provenance / BASELINE.json configs[2]):
+the VGG-16/CIFAR-10 configuration that validates the fork's double-buffered
+allreduce optimizer — gradient allreduce of step t-1 overlapping the
+forward/backward of step t, applied with one step of staleness.
+
+Without ``--data`` a synthetic CIFAR-shaped dataset is used (class-dependent
+means, so convergence is real).
+
+    python examples/cifar/train_cifar.py --double-buffering \
+        --communicator xla --allreduce-grad-dtype bfloat16
+"""
+
+import argparse
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.datasets import TupleDataset
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import VGG16
+from chainermn_tpu.optimizers import (
+    init_model_state, init_opt_state, make_train_step)
+from chainermn_tpu.training import StatefulUpdater, Trainer, extensions
+
+
+def make_synthetic_cifar(n, seed):
+    rng = np.random.RandomState(seed)
+    y = (rng.rand(n) * 10).astype(np.int32)
+    x = rng.randn(n, 32, 32, 3).astype(np.float32) * 0.5
+    x += y.reshape(-1, 1, 1, 1) * 0.25
+    return TupleDataset(x, y)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="chainermn_tpu CIFAR example")
+    parser.add_argument("--batchsize", "-b", type=int, default=64)
+    parser.add_argument("--epoch", "-e", type=int, default=20)
+    parser.add_argument("--communicator", default="xla")
+    parser.add_argument("--allreduce-grad-dtype", default=None)
+    parser.add_argument("--double-buffering", action="store_true")
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--out", "-o", default="result")
+    parser.add_argument("--data", default=None,
+                        help="npz with x_train/y_train arrays (NHWC)")
+    parser.add_argument("--train-size", type=int, default=8192)
+    parser.add_argument("--intra-size", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    comm = chainermn_tpu.create_communicator(
+        args.communicator, intra_size=args.intra_size,
+        allreduce_grad_dtype=args.allreduce_grad_dtype)
+    model = VGG16(num_classes=10, dtype=jnp.dtype(args.dtype))
+
+    if comm.rank == 0:
+        print(f"Num devices: {comm.size}; communicator {args.communicator}; "
+              f"double_buffering={args.double_buffering}")
+
+    if args.data:
+        with np.load(args.data) as d:
+            train = TupleDataset(d["x_train"].astype(np.float32),
+                                 d["y_train"].astype(np.int32))
+    else:
+        train = make_synthetic_cifar(args.train_size, args.seed)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
+                                          seed=args.seed)
+    # reference batchsize is per-rank(GPU); this host feeds its local devices
+    local_bs = args.batchsize * comm.size // comm.host_size
+    train_iter = SerialIterator(train, local_bs, shuffle=True,
+                                seed=args.seed)
+
+    variables = model.init(jax.random.key(args.seed),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32),
+                           train=False)
+    params = comm.bcast_data(variables["params"])
+    model_state = init_model_state(comm, variables["batch_stats"])
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(args.lr, momentum=0.9), comm,
+        double_buffering=args.double_buffering)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    # Per-iteration dropout keys (see train_imagenet.py for the pattern).
+    step_counter = itertools.count()
+
+    def convert(batch):
+        x, y = batch
+        it = np.full((len(x),), next(step_counter), np.uint32)
+        return x, y, it
+
+    def loss_fn(p, state, batch):
+        x, y, it = batch
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(args.seed), it[0]),
+            comm.axis_index())
+        logits, mutated = model.apply(
+            {"params": p, "batch_stats": state}, x, train=True,
+            mutable=["batch_stats"], rngs={"dropout": rng})
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
+        return loss, (mutated["batch_stats"], {"accuracy": acc})
+
+    step = make_train_step(comm, loss_fn, optimizer, has_aux=True,
+                           with_model_state=True)
+    updater = StatefulUpdater(train_iter, step, params, model_state,
+                              opt_state, comm, convert_batch=convert)
+    trainer = Trainer(updater, (args.epoch, "epoch"), out=args.out)
+    trainer.extend(chainermn_tpu.AllreducePersistent(
+        comm, lambda t: t.updater.model_state,
+        lambda t, s: setattr(t.updater, "model_state", s)))
+    if comm.rank == 0:
+        trainer.extend(extensions.LogReport(trigger=(1, "epoch")))
+        trainer.extend(extensions.PrintReport(
+            ["epoch", "iteration", "main/loss", "main/accuracy",
+             "elapsed_time"]))
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

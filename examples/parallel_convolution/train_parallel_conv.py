@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Channel-wise (filter-wise) parallel convolution demo.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance / §2.4):
+〔examples/parallel_convolution/〕 — the reference's example-level ancestor
+of tensor parallelism: each rank owns a slice of every conv layer's output
+channels, computes its slice, and the ranks allgather activations between
+layers.  In the reference this is an example pattern, not a framework
+feature, and the same is true here.
+
+TPU-native: the "ranks" are mesh devices under ``comm.run_spmd``; the
+per-layer exchange is the differentiable ``allgather`` (backward = slice of
+the incoming gradient), lowered by XLA to an ICI all-gather.
+
+    python examples/parallel_convolution/train_parallel_conv.py
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu import functions as F
+from chainermn_tpu.training import put_global_batch
+
+
+class ChannelShardedCNN(nn.Module):
+    """Each instance holds 1/size of every conv's filters."""
+
+    channels_per_device: int = 8
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, comm):
+        # conv1: full input, 1/size of the output channels ...
+        y = nn.relu(nn.Conv(self.channels_per_device, (3, 3),
+                            padding="SAME")(x))
+        # ... allgather along channels so conv2 sees every feature map
+        y = F.allgather(comm, y)            # [size, B, H, W, C/size]
+        y = jnp.concatenate(list(y), axis=-1)
+        y = nn.max_pool(y, (2, 2), strides=(2, 2))
+        y = nn.relu(nn.Conv(self.channels_per_device, (3, 3),
+                            padding="SAME")(y))
+        y = F.allgather(comm, y)
+        y = jnp.concatenate(list(y), axis=-1)
+        y = y.mean(axis=(1, 2))
+        return nn.Dense(self.n_classes)(y)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batchsize", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    comm = chainermn_tpu.create_communicator("xla")
+    model = ChannelShardedCNN()
+
+    rng = np.random.RandomState(args.seed)
+    y_lab = (rng.rand(args.batchsize) * 10).astype(np.int32)
+    x = rng.randn(args.batchsize, 16, 16, 3).astype(np.float32)
+    x += y_lab.reshape(-1, 1, 1, 1) * 0.3
+
+    # Every device sees the SAME batch but owns DIFFERENT filters, so params
+    # are initialized per-device (device-varying), the opposite of data
+    # parallelism.
+    def init_one(seed):
+        return model.init(jax.random.key(seed[0]),
+                          jnp.zeros((1, 16, 16, 3)), comm)
+
+    seeds = np.arange(comm.size, dtype=np.uint32).reshape(comm.size, 1)
+    params = comm.run_spmd(init_one, put_global_batch(comm, seeds))
+
+    opt = optax.adam(args.lr)
+    xb = jnp.asarray(x)
+    yb = jnp.asarray(y_lab)
+
+    def train_some(params, opt_state):
+        def body(p, s):
+            def loss_fn(pp):
+                logits = model.apply(pp, xb, comm)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb).mean()
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            updates, s = opt.update(g, s, p)
+            return optax.apply_updates(p, updates), s, loss
+        return comm.run_spmd(body, params, opt_state)
+
+    opt_state = comm.run_spmd(
+        lambda p: opt.init(p), params)
+    first = last = None
+    for i in range(args.steps):
+        params, opt_state, loss = train_some(params, opt_state)
+        l = float(np.asarray(jax.device_get(loss)).mean())
+        if first is None:
+            first = l
+        last = l
+        if i % 10 == 0 and comm.rank == 0:
+            print(f"step {i}: loss {l:.4f}")
+    if comm.rank == 0:
+        print(f"loss {first:.4f} -> {last:.4f}")
+    assert last < first, "channel-parallel training should reduce the loss"
+
+
+if __name__ == "__main__":
+    main()
