@@ -45,11 +45,14 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     n_dev = jax.device_count()
     if on_tpu:
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        n_classes = 1000
+        model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16)
         per_chip_batch, image, steps, warmup = 128, 224, 20, 5
     else:  # CPU smoke path: tiny ResNet so the contract can be exercised
+        n_classes = 10
         model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
-                       num_filters=8, num_classes=10, dtype=jnp.float32)
+                       num_filters=8, num_classes=n_classes,
+                       dtype=jnp.float32)
         per_chip_batch, image, steps, warmup = 8, 32, 5, 2
 
     comm = chainermn_tpu.create_communicator(
@@ -79,7 +82,7 @@ def main():
     global_batch = per_chip_batch * comm.size
     rng = np.random.RandomState(0)
     x = rng.randn(global_batch, image, image, 3).astype(np.float32)
-    y = (rng.rand(global_batch) * 1000).astype(np.int32)
+    y = (rng.rand(global_batch) * n_classes).astype(np.int32)
     batch = put_global_batch(comm, (x, y))
 
     for i in range(warmup):

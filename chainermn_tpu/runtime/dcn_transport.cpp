@@ -1,0 +1,453 @@
+// DCN point-to-point byte transport — native core.
+//
+// The rebuild's analogue of the reference's native communication surface
+// (SURVEY.md §2.3: MPI C library + NCCL binding): the framing/socket layer
+// under chainermn_tpu.runtime.control_plane, carrying pickled control-plane
+// objects between TPU host controllers over DCN.  Wire-compatible with the
+// pure-Python fallback in transport.py:
+//
+//   frame    := u32 src | u32 tag | u64 len | payload          (little endian)
+//   handshake: every rank connects to the coordinator (rank 0) and sends its
+//   listen address; rank 0 replies with the full peer table as JSON
+//   [[rank, "host:port"], ...] — the reference's hostname-allgather bootstrap
+//   (init_ranks 〔_communication_utility.py〕) over sockets.
+//
+// Exposed as a C ABI consumed by ctypes (runtime/native.py); no Python.h
+// dependency, so it builds with a bare `g++ -shared`.
+//
+// Build: g++ -O2 -std=c++17 -fPIC -shared -pthread dcn_transport.cpp -o _libdcn.so
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FrameHeader {
+  uint32_t src;
+  uint32_t tag;
+  uint64_t len;
+} __attribute__((packed));
+
+static_assert(sizeof(FrameHeader) == 16, "header must match Python struct <IIQ");
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) {
+      if (k < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool write_frame(int fd, uint32_t src, uint32_t tag, const void* payload,
+                 uint64_t len) {
+  FrameHeader h{src, tag, len};
+  // One buffered write for small frames avoids a partial-header race with
+  // TCP_NODELAY; large payloads go in two writes.
+  if (len <= 64 * 1024) {
+    std::vector<char> buf(sizeof(h) + len);
+    std::memcpy(buf.data(), &h, sizeof(h));
+    if (len) std::memcpy(buf.data() + sizeof(h), payload, len);
+    return send_all(fd, buf.data(), buf.size());
+  }
+  return send_all(fd, &h, sizeof(h)) && send_all(fd, payload, len);
+}
+
+bool read_frame(int fd, uint32_t* src, uint32_t* tag, std::string* payload) {
+  FrameHeader h;
+  if (!recv_all(fd, &h, sizeof(h))) return false;
+  payload->resize(h.len);
+  if (h.len && !recv_all(fd, payload->data(), h.len)) return false;
+  *src = h.src;
+  *tag = h.tag;
+  return true;
+}
+
+int connect_to(const std::string& host, int port, double timeout_s) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  for (;;) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) == 0 && res) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) {
+        if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          freeaddrinfo(res);
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          return fd;
+        }
+        ::close(fd);
+      }
+      freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+// Parse the handshake table [[rank, "host:port"], ...] (json.dumps output of
+// the Python side).  Minimal scanner — the format is fixed and machine
+// generated; anything unexpected fails the handshake rather than guessing.
+bool parse_table(const std::string& s, std::map<int, std::string>* out) {
+  size_t i = 0;
+  auto skip_ws = [&] { while (i < s.size() && isspace((unsigned char)s[i])) ++i; };
+  skip_ws();
+  if (i >= s.size() || s[i] != '[') return false;
+  ++i;
+  for (;;) {
+    skip_ws();
+    if (i < s.size() && s[i] == ']') { ++i; return true; }
+    if (i >= s.size() || s[i] != '[') return false;
+    ++i;
+    skip_ws();
+    size_t j = i;
+    while (j < s.size() && (isdigit((unsigned char)s[j]) || s[j] == '-')) ++j;
+    if (j == i) return false;
+    int rank = std::stoi(s.substr(i, j - i));
+    i = j;
+    skip_ws();
+    if (i >= s.size() || s[i] != ',') return false;
+    ++i;
+    skip_ws();
+    if (i >= s.size() || s[i] != '"') return false;
+    ++i;
+    j = s.find('"', i);
+    if (j == std::string::npos) return false;
+    (*out)[rank] = s.substr(i, j - i);
+    i = j + 1;
+    skip_ws();
+    if (i >= s.size() || s[i] != ']') return false;
+    ++i;
+    skip_ws();
+    if (i < s.size() && s[i] == ',') ++i;
+  }
+}
+
+std::string dump_table(const std::map<int, std::string>& table) {
+  std::string out = "[";
+  bool first = true;
+  for (const auto& [rank, addr] : table) {
+    if (!first) out += ", ";
+    first = false;
+    out += "[" + std::to_string(rank) + ", \"" + addr + "\"]";
+  }
+  out += "]";
+  return out;
+}
+
+class Transport {
+ public:
+  Transport(int rank, int size) : rank_(rank), size_(size) {}
+
+  bool init(const std::string& coordinator, const std::string& my_host) {
+    // Listen on an ephemeral port.
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return fail("socket() failed");
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = 0;
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0)
+      return fail("bind() failed");
+    if (::listen(listen_fd_, size_ + 8) != 0) return fail("listen() failed");
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &alen);
+    int my_port = ntohs(addr.sin_port);
+
+    accept_thread_ = std::thread([this] { accept_loop(); });
+
+    std::string my_addr = my_host + ":" + std::to_string(my_port);
+    auto colon = coordinator.rfind(':');
+    if (colon == std::string::npos) return fail("coordinator must be host:port");
+    std::string chost = coordinator.substr(0, colon);
+    int cport = std::stoi(coordinator.substr(colon + 1));
+    return handshake(chost, cport, my_addr);
+  }
+
+  bool send(int dest, uint32_t tag, const void* data, uint64_t len) {
+    if (dest == rank_) {
+      std::string payload(static_cast<const char*>(data), len);
+      push(rank_, tag, std::move(payload));
+      return true;
+    }
+    std::unique_lock<std::mutex> out_guard(out_mutex_);
+    auto& lock = out_locks_[dest];  // per-dest serialization
+    out_guard.unlock();
+    std::lock_guard<std::mutex> g(lock);
+    int fd;
+    {
+      std::lock_guard<std::mutex> g2(out_mutex_);
+      auto it = out_fds_.find(dest);
+      fd = it == out_fds_.end() ? -1 : it->second;
+    }
+    if (fd < 0) {
+      auto it = peers_.find(dest);
+      if (it == peers_.end()) return fail("unknown peer " + std::to_string(dest));
+      auto colon = it->second.rfind(':');
+      fd = connect_to(it->second.substr(0, colon),
+                      std::stoi(it->second.substr(colon + 1)), 30.0);
+      if (fd < 0) return fail("connect to peer " + std::to_string(dest) + " failed");
+      std::lock_guard<std::mutex> g2(out_mutex_);
+      out_fds_[dest] = fd;
+    }
+    if (!write_frame(fd, rank_, tag, data, len))
+      return fail("send to peer " + std::to_string(dest) + " failed");
+    return true;
+  }
+
+  // Returns true and fills *out, or false on timeout/shutdown.
+  bool recv(int source, uint32_t tag, double timeout_s, std::string* out) {
+    std::unique_lock<std::mutex> lk(inbox_mutex_);
+    auto key = std::make_pair(source, tag);
+    bool ok = inbox_cv_.wait_for(
+        lk, std::chrono::duration<double>(timeout_s),
+        [&] { return closed_.load() || !inbox_[key].empty(); });
+    if (!ok || inbox_[key].empty())
+      return fail(closed_.load() ? "transport closed"
+                                 : "recv timed out (source " +
+                                       std::to_string(source) + ", tag " +
+                                       std::to_string(tag) + ")");
+    *out = std::move(inbox_[key].front());
+    inbox_[key].pop_front();
+    return true;
+  }
+
+  void close() {
+    closed_.store(true);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(out_mutex_);
+      for (auto& [dest, fd] : out_fds_) ::close(fd);
+      out_fds_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> g(conn_mutex_);
+      for (int fd : in_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    inbox_cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : reader_threads_)
+      if (t.joinable()) t.join();
+  }
+
+  const std::map<int, std::string>& peers() const { return peers_; }
+
+ private:
+  bool fail(const std::string& msg) {
+    set_error(msg + (errno ? std::string(": ") + strerror(errno) : ""));
+    return false;
+  }
+
+  void push(int src, uint32_t tag, std::string&& payload) {
+    {
+      std::lock_guard<std::mutex> g(inbox_mutex_);
+      inbox_[{src, tag}].push_back(std::move(payload));
+    }
+    inbox_cv_.notify_all();
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (closed_.load()) return;
+        if (errno == EINTR) continue;
+        return;
+      }
+      {
+        std::lock_guard<std::mutex> g(conn_mutex_);
+        in_fds_.push_back(fd);
+        reader_threads_.emplace_back([this, fd] { reader_loop(fd); });
+      }
+    }
+  }
+
+  void reader_loop(int fd) {
+    uint32_t src, tag;
+    std::string payload;
+    while (read_frame(fd, &src, &tag, &payload)) {
+      push(static_cast<int>(src), tag, std::move(payload));
+      payload.clear();
+    }
+    ::close(fd);
+  }
+
+  bool handshake(const std::string& chost, int cport, const std::string& my_addr) {
+    if (rank_ == 0) {
+      int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (srv < 0) return fail("coordinator socket() failed");
+      int one = 1;
+      setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_addr.s_addr = INADDR_ANY;
+      addr.sin_port = htons(cport);
+      if (::bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0)
+        return fail("coordinator bind(" + std::to_string(cport) + ") failed");
+      if (::listen(srv, size_ + 8) != 0) return fail("coordinator listen failed");
+      peers_[0] = my_addr;
+      std::vector<std::pair<int, int>> conns;  // (rank, fd)
+      while (static_cast<int>(peers_.size()) < size_) {
+        int c = ::accept(srv, nullptr, nullptr);
+        if (c < 0) {
+          if (errno == EINTR) continue;
+          ::close(srv);
+          return fail("coordinator accept failed");
+        }
+        uint32_t src, tag;
+        std::string payload;
+        if (!read_frame(c, &src, &tag, &payload)) {
+          ::close(c);
+          continue;
+        }
+        peers_[static_cast<int>(src)] = payload;
+        conns.emplace_back(static_cast<int>(src), c);
+      }
+      std::string blob = dump_table(peers_);
+      for (auto& [r, c] : conns) {
+        write_frame(c, 0, 0, blob.data(), blob.size());
+        ::close(c);
+      }
+      ::close(srv);
+      return true;
+    }
+    int c = connect_to(chost, cport, 60.0);
+    if (c < 0) return fail("connect to coordinator failed");
+    if (!write_frame(c, rank_, 0, my_addr.data(), my_addr.size())) {
+      ::close(c);
+      return fail("handshake send failed");
+    }
+    uint32_t src, tag;
+    std::string blob;
+    bool ok = read_frame(c, &src, &tag, &blob);
+    ::close(c);
+    if (!ok) return fail("handshake recv failed");
+    if (!parse_table(blob, &peers_)) return fail("bad handshake table: " + blob);
+    return true;
+  }
+
+  int rank_, size_;
+  int listen_fd_ = -1;
+  std::atomic<bool> closed_{false};
+  std::map<int, std::string> peers_;
+
+  std::thread accept_thread_;
+  std::mutex conn_mutex_;
+  std::vector<int> in_fds_;
+  std::vector<std::thread> reader_threads_;
+
+  std::mutex out_mutex_;
+  std::map<int, int> out_fds_;
+  std::map<int, std::mutex> out_locks_;
+
+  std::mutex inbox_mutex_;
+  std::condition_variable inbox_cv_;
+  std::map<std::pair<int, uint32_t>, std::deque<std::string>> inbox_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dcn_create(int rank, int size, const char* coordinator,
+                 const char* my_host) {
+  auto* t = new Transport(rank, size);
+  if (!t->init(coordinator, my_host)) {
+    // close() joins the already-running accept thread; deleting a Transport
+    // with a joinable std::thread would std::terminate the whole process.
+    std::string err = g_last_error;  // close() may overwrite it
+    t->close();
+    g_last_error = err;
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+int dcn_send(void* handle, int dest, uint32_t tag, const uint8_t* data,
+             uint64_t len) {
+  return static_cast<Transport*>(handle)->send(dest, tag, data, len) ? 0 : -1;
+}
+
+// On success returns len and sets *out (caller frees with dcn_free); on
+// failure returns -1.
+int64_t dcn_recv(void* handle, int source, uint32_t tag, double timeout_s,
+                 uint8_t** out) {
+  std::string payload;
+  if (!static_cast<Transport*>(handle)->recv(source, tag, timeout_s, &payload))
+    return -1;
+  auto* buf = static_cast<uint8_t*>(::malloc(payload.size()));
+  std::memcpy(buf, payload.data(), payload.size());
+  *out = buf;
+  return static_cast<int64_t>(payload.size());
+}
+
+void dcn_free(uint8_t* buf) { ::free(buf); }
+
+// Peer table as the handshake JSON (for introspection/debugging).
+int64_t dcn_peers(void* handle, char* out, int64_t cap) {
+  std::string s = dump_table(static_cast<Transport*>(handle)->peers());
+  if (static_cast<int64_t>(s.size()) + 1 > cap) return -(int64_t)s.size() - 1;
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return static_cast<int64_t>(s.size());
+}
+
+void dcn_close(void* handle) {
+  auto* t = static_cast<Transport*>(handle);
+  t->close();
+  delete t;
+}
+
+const char* dcn_last_error() { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+"""ctypes binding for the native C++ DCN transport core.
+
+Loads (building on demand with g++ if needed) ``dcn_transport.cpp`` — the
+rebuild's native communication surface (SURVEY.md §2.3).  Wire-compatible
+with :class:`chainermn_tpu.runtime.transport.PyTransport`; ``create_transport``
+prefers this backend and falls back to pure Python when no compiler is
+available (mirroring the reference's pure-Python install path, which ran
+without its optional Cython NCCL extension).
+
+Build cache: ``_libdcn.so`` next to the source, rebuilt when the source is
+newer.  Disable with ``CHAINERMN_TPU_NATIVE_BUILD=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "dcn_transport.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_libdcn.so")
+_BUILD_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    if os.environ.get("CHAINERMN_TPU_NATIVE_BUILD") == "0":
+        raise ImportError("native build disabled (CHAINERMN_TPU_NATIVE_BUILD=0)")
+    if (os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+        return _LIB
+    tmp = _LIB + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        raise ImportError(
+            f"building dcn_transport failed: {e}\n{stderr.decode()}") from e
+    os.replace(tmp, _LIB)  # atomic under concurrent builders
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _BUILD_LOCK:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            lib.dcn_create.restype = ctypes.c_void_p
+            lib.dcn_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_char_p, ctypes.c_char_p]
+            lib.dcn_send.restype = ctypes.c_int
+            lib.dcn_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_uint32, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+            lib.dcn_recv.restype = ctypes.c_int64
+            lib.dcn_recv.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_uint32, ctypes.c_double,
+                                     ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+            lib.dcn_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            lib.dcn_peers.restype = ctypes.c_int64
+            lib.dcn_peers.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64]
+            lib.dcn_close.argtypes = [ctypes.c_void_p]
+            lib.dcn_last_error.restype = ctypes.c_char_p
+            _lib = lib
+    return _lib
+
+
+class NativeTransport:
+    """Same surface as ``PyTransport`` (send/recv/close/peers), C++ core."""
+
+    def __init__(self, rank: int, size: int, coordinator: str):
+        lib = _load()
+        self._lib = lib
+        self.rank = rank
+        self.size = size
+        my_host = os.environ.get("CHAINERMN_TPU_HOST", "127.0.0.1")
+        handle = lib.dcn_create(rank, size, coordinator.encode(),
+                                my_host.encode())
+        if not handle:
+            raise OSError(
+                f"native transport init failed: "
+                f"{lib.dcn_last_error().decode()}")
+        self._handle = handle
+        self._closed = False
+
+    @property
+    def peers(self):
+        import json
+
+        buf = ctypes.create_string_buffer(65536)
+        n = self._lib.dcn_peers(self._handle, buf, len(buf))
+        if n < 0:
+            raise OSError("peer table too large")
+        return {int(r): a for r, a in json.loads(buf.value.decode())}
+
+    def send(self, dest: int, tag: int, payload: bytes):
+        rc = self._lib.dcn_send(self._handle, dest, tag, payload,
+                                len(payload))
+        if rc != 0:
+            raise OSError(f"native send failed: "
+                          f"{self._lib.dcn_last_error().decode()}")
+
+    def recv(self, source: int, tag: int, timeout: float = 300.0) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.dcn_recv(self._handle, source, tag, timeout,
+                               ctypes.byref(out))
+        if n < 0:
+            raise TimeoutError(
+                f"native recv from rank {source} (tag {tag}): "
+                f"{self._lib.dcn_last_error().decode()}")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.dcn_free(out)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.dcn_close(self._handle)
