@@ -17,6 +17,7 @@ On CPU (no TPU attached) a reduced shape keeps the smoke run short; the
 JSON line is still emitted so the harness contract holds everywhere.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -31,6 +32,12 @@ def log(*a):
 
 
 def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--profile", default=None, metavar="DIR",
+                        help="capture a jax.profiler trace of the timed "
+                             "steps into DIR")
+    args = parser.parse_args()
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -91,12 +98,17 @@ def main():
     jax.block_until_ready(loss)
     log(f"bench: warmup done, loss={float(loss):.3f}")
 
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     t0 = time.perf_counter()
     for i in range(steps):
         params, model_state, opt_state, loss = step(
             params, model_state, opt_state, batch)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+        log(f"bench: profile written to {args.profile}")
 
     img_per_sec = global_batch * steps / dt
     per_chip = img_per_sec / n_dev

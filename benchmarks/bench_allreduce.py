@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Allreduce microbenchmark — north-star metric #2 (BASELINE.md).
+
+Times ``allreduce_grad`` over a packed gradient buffer for each
+communicator flavor and reports algorithmic bus bandwidth
+(2*(n-1)/n * bytes / time, the standard ring-allreduce accounting).
+
+On a multi-chip slice, running this per slice size yields the
+8 -> 256-chip scaling table; on one chip / a virtual CPU mesh it validates
+the harness and the per-flavor collective decompositions.
+
+    python benchmarks/bench_allreduce.py --mb 64 --communicators xla,hierarchical
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=float, default=64.0,
+                        help="payload size in MiB (fp32)")
+    parser.add_argument("--dtype", default="float32",
+                        help="gradient dtype before any communication cast")
+    parser.add_argument("--allreduce-grad-dtype", default=None,
+                        help="communication dtype for the xla communicator")
+    parser.add_argument("--communicators", default="naive,xla,hierarchical",
+                        help="comma-separated flavor list")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--intra-size", type=int, default=None)
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON line per flavor")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+
+    n_elems = int(args.mb * (1 << 20) / np.dtype(args.dtype).itemsize)
+    results = []
+    for name in args.communicators.split(","):
+        kwargs = {}
+        if args.allreduce_grad_dtype and name in ("xla", "pure_nccl"):
+            kwargs["allreduce_grad_dtype"] = args.allreduce_grad_dtype
+        comm = chainermn_tpu.create_communicator(
+            name, intra_size=args.intra_size, **kwargs)
+        n = comm.size
+        # one distinct buffer per rank so the collective does real work
+        stacked = jnp.tile(
+            jnp.arange(n, dtype=args.dtype).reshape(n, 1), (1, n_elems))
+
+        def body(g):
+            return comm.allreduce_grad(g)
+
+        out = comm.run_spmd(body, stacked)     # compile + correctness
+        expect = (n - 1) / 2.0
+        np.testing.assert_allclose(
+            np.asarray(out[0, :3]), expect, rtol=1e-2)
+        for _ in range(args.warmup):
+            out = comm.run_spmd(body, stacked)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = comm.run_spmd(body, stacked)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        payload = n_elems * np.dtype(args.dtype).itemsize
+        busbw = 2 * (n - 1) / n * payload / dt / 1e9
+        row = {"communicator": name, "devices": n,
+               "payload_mib": round(payload / (1 << 20), 1),
+               "time_ms": round(dt * 1e3, 3),
+               "busbw_gbps": round(busbw, 2)}
+        results.append(row)
+        if args.json:
+            print(json.dumps(row), flush=True)
+        else:
+            print(f"{name:>16}: {n} devices, {row['payload_mib']} MiB, "
+                  f"{row['time_ms']} ms, {row['busbw_gbps']} GB/s bus",
+                  file=sys.stderr)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -197,17 +197,24 @@ class MeshCommunicator(CommunicatorBase):
         holding the per-rank values; results come back stacked the same way.
         Inside ``f``, this communicator's traced collectives and
         ``axis_index()`` behave like the reference's per-rank API.
+
+        The mapped/jitted program is cached per ``f`` (by identity), so
+        calling ``run_spmd`` with the same function in a loop reuses the
+        compiled executable instead of retracing every iteration.
         """
         spec = P(self._data_axes)
+        fn = self._jit_cache.get((f, jit))
+        if fn is None:
+            def per_rank(args):
+                squeezed = jax.tree.map(lambda a: jnp.squeeze(a, 0), args)
+                out = f(*squeezed)
+                return jax.tree.map(lambda a: jnp.expand_dims(a, 0), out)
 
-        def per_rank(args):
-            squeezed = jax.tree.map(lambda a: jnp.squeeze(a, 0), args)
-            out = f(*squeezed)
-            return jax.tree.map(lambda a: jnp.expand_dims(a, 0), out)
-
-        fn = jax.shard_map(per_rank, mesh=self._mesh, in_specs=spec, out_specs=spec)
-        if jit:
-            fn = jax.jit(fn)
+            fn = jax.shard_map(per_rank, mesh=self._mesh,
+                               in_specs=spec, out_specs=spec)
+            if jit:
+                fn = jax.jit(fn)
+            self._jit_cache[(f, jit)] = fn
         for i, arg in enumerate(stacked_args):
             for leaf in jax.tree.leaves(arg):
                 shape = jnp.shape(leaf)

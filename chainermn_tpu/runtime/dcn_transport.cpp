@@ -24,6 +24,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -244,17 +245,25 @@ class Transport {
   // Returns true and fills *out, or false on timeout/shutdown.
   bool recv(int source, uint32_t tag, double timeout_s, std::string* out) {
     std::unique_lock<std::mutex> lk(inbox_mutex_);
+    // Registered so close() can wait for in-flight receivers to drain
+    // before the object is destroyed (use-after-free otherwise).
+    ++active_recvs_;
     auto key = std::make_pair(source, tag);
     bool ok = inbox_cv_.wait_for(
         lk, std::chrono::duration<double>(timeout_s),
         [&] { return closed_.load() || !inbox_[key].empty(); });
-    if (!ok || inbox_[key].empty())
+    bool success = ok && !inbox_[key].empty();
+    if (success) {
+      *out = std::move(inbox_[key].front());
+      inbox_[key].pop_front();
+    }
+    --active_recvs_;
+    inbox_cv_.notify_all();
+    if (!success)
       return fail(closed_.load() ? "transport closed"
                                  : "recv timed out (source " +
                                        std::to_string(source) + ", tag " +
                                        std::to_string(tag) + ")");
-    *out = std::move(inbox_[key].front());
-    inbox_[key].pop_front();
     return true;
   }
 
@@ -274,7 +283,13 @@ class Transport {
       std::lock_guard<std::mutex> g(conn_mutex_);
       for (int fd : in_fds_) ::shutdown(fd, SHUT_RDWR);
     }
-    inbox_cv_.notify_all();
+    {
+      // Wake blocked receivers and wait for them to leave recv() before the
+      // destructor tears down the mutex/condvar they are using.
+      std::unique_lock<std::mutex> lk(inbox_mutex_);
+      inbox_cv_.notify_all();
+      inbox_cv_.wait(lk, [&] { return active_recvs_ == 0; });
+    }
     if (accept_thread_.joinable()) accept_thread_.join();
     for (auto& t : reader_threads_)
       if (t.joinable()) t.join();
@@ -318,6 +333,13 @@ class Transport {
     while (read_frame(fd, &src, &tag, &payload)) {
       push(static_cast<int>(src), tag, std::move(payload));
       payload.clear();
+    }
+    {
+      // De-register before closing: otherwise close() could ::shutdown a
+      // recycled descriptor number belonging to an unrelated connection.
+      std::lock_guard<std::mutex> g(conn_mutex_);
+      in_fds_.erase(std::remove(in_fds_.begin(), in_fds_.end(), fd),
+                    in_fds_.end());
     }
     ::close(fd);
   }
@@ -378,6 +400,7 @@ class Transport {
 
   int rank_, size_;
   int listen_fd_ = -1;
+  int active_recvs_ = 0;  // guarded by inbox_mutex_
   std::atomic<bool> closed_{false};
   std::map<int, std::string> peers_;
 
@@ -397,10 +420,13 @@ class Transport {
 
 }  // namespace
 
+// C++ exceptions (std::stoi on malformed ports/ranks, bad_alloc, ...) must
+// not unwind into the ctypes FFI frame — that std::terminates the whole
+// Python process.  Every extern "C" body is exception-contained.
 extern "C" {
 
 void* dcn_create(int rank, int size, const char* coordinator,
-                 const char* my_host) {
+                 const char* my_host) try {
   auto* t = new Transport(rank, size);
   if (!t->init(coordinator, my_host)) {
     // close() joins the already-running accept thread; deleting a Transport
@@ -412,17 +438,29 @@ void* dcn_create(int rank, int size, const char* coordinator,
     return nullptr;
   }
   return t;
+} catch (const std::exception& e) {
+  set_error(std::string("native transport init: ") + e.what());
+  return nullptr;
+} catch (...) {
+  set_error("native transport init: unknown C++ exception");
+  return nullptr;
 }
 
 int dcn_send(void* handle, int dest, uint32_t tag, const uint8_t* data,
-             uint64_t len) {
+             uint64_t len) try {
   return static_cast<Transport*>(handle)->send(dest, tag, data, len) ? 0 : -1;
+} catch (const std::exception& e) {
+  set_error(std::string("native send: ") + e.what());
+  return -1;
+} catch (...) {
+  set_error("native send: unknown C++ exception");
+  return -1;
 }
 
 // On success returns len and sets *out (caller frees with dcn_free); on
 // failure returns -1.
 int64_t dcn_recv(void* handle, int source, uint32_t tag, double timeout_s,
-                 uint8_t** out) {
+                 uint8_t** out) try {
   std::string payload;
   if (!static_cast<Transport*>(handle)->recv(source, tag, timeout_s, &payload))
     return -1;
@@ -430,6 +468,12 @@ int64_t dcn_recv(void* handle, int source, uint32_t tag, double timeout_s,
   std::memcpy(buf, payload.data(), payload.size());
   *out = buf;
   return static_cast<int64_t>(payload.size());
+} catch (const std::exception& e) {
+  set_error(std::string("native recv: ") + e.what());
+  return -1;
+} catch (...) {
+  set_error("native recv: unknown C++ exception");
+  return -1;
 }
 
 void dcn_free(uint8_t* buf) { ::free(buf); }
@@ -442,10 +486,12 @@ int64_t dcn_peers(void* handle, char* out, int64_t cap) {
   return static_cast<int64_t>(s.size());
 }
 
-void dcn_close(void* handle) {
+void dcn_close(void* handle) try {
   auto* t = static_cast<Transport*>(handle);
   t->close();
   delete t;
+} catch (...) {
+  set_error("native close: unknown C++ exception");
 }
 
 const char* dcn_last_error() { return g_last_error.c_str(); }

@@ -92,6 +92,39 @@ class TestNativeTransport:
         finally:
             [t.close() for t in tps]
 
+    def test_bad_coordinator_raises_not_aborts(self):
+        """std::stoi on a malformed port must surface as OSError, not kill
+        the interpreter through the FFI boundary."""
+        from chainermn_tpu.runtime.native import NativeTransport
+
+        with pytest.raises(OSError):
+            NativeTransport(1, 2, "127.0.0.1:notaport")
+
+    def test_close_while_recv_blocked(self):
+        """close() must drain in-flight receivers (no use-after-free)."""
+        from chainermn_tpu.runtime.native import NativeTransport
+
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([lambda r, s, c: NativeTransport(r, s, c)] * 2, coord)
+        got = []
+
+        def blocked():
+            try:
+                tps[0].recv(1, 99, timeout=30)
+            except (TimeoutError, OSError) as e:
+                got.append(e)
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        import time
+
+        time.sleep(0.2)  # let it block inside native recv
+        tps[0].close()
+        t.join(10)
+        assert not t.is_alive()
+        assert got and isinstance(got[0], (TimeoutError, OSError))
+        tps[1].close()
+
     def test_recv_timeout(self):
         from chainermn_tpu.runtime.native import NativeTransport
 
