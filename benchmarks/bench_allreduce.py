@@ -14,10 +14,14 @@ the harness and the per-flavor collective decompositions.
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Runnable from a fresh clone without `pip install -e .`.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

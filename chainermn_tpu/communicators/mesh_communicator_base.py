@@ -19,6 +19,7 @@ TPU-native design (see ``communicator_base.py`` for the two-level model):
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -93,7 +94,11 @@ class MeshCommunicator(CommunicatorBase):
         self.allreduce_grad_dtype = (
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype is not None else None)
         self._cp = control_plane if control_plane is not None else cp_mod.get_control_plane()
-        self._jit_cache: dict = {}
+        # LRU keyed by (f identity, jit flag).  Bounded: callers that define
+        # their body per call would otherwise grow it without limit (and pin
+        # the closures' captured arrays) while never hitting.
+        self._jit_cache: OrderedDict = OrderedDict()
+        self._jit_cache_max = 32
 
     # ---- topology ----------------------------------------------------------
     @property
@@ -203,8 +208,11 @@ class MeshCommunicator(CommunicatorBase):
         compiled executable instead of retracing every iteration.
         """
         spec = P(self._data_axes)
-        fn = self._jit_cache.get((f, jit))
-        if fn is None:
+        key = (f, jit)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            self._jit_cache.move_to_end(key)
+        else:
             def per_rank(args):
                 squeezed = jax.tree.map(lambda a: jnp.squeeze(a, 0), args)
                 out = f(*squeezed)
@@ -214,7 +222,9 @@ class MeshCommunicator(CommunicatorBase):
                                in_specs=spec, out_specs=spec)
             if jit:
                 fn = jax.jit(fn)
-            self._jit_cache[(f, jit)] = fn
+            self._jit_cache[key] = fn
+            while len(self._jit_cache) > self._jit_cache_max:
+                self._jit_cache.popitem(last=False)
         for i, arg in enumerate(stacked_args):
             for leaf in jax.tree.leaves(arg):
                 shape = jnp.shape(leaf)

@@ -224,6 +224,7 @@ class Transport {
     int fd;
     {
       std::lock_guard<std::mutex> g2(out_mutex_);
+      if (closed_.load()) return fail("transport closed");
       auto it = out_fds_.find(dest);
       fd = it == out_fds_.end() ? -1 : it->second;
     }
@@ -235,9 +236,27 @@ class Transport {
                       std::stoi(it->second.substr(colon + 1)), 30.0);
       if (fd < 0) return fail("connect to peer " + std::to_string(dest) + " failed");
       std::lock_guard<std::mutex> g2(out_mutex_);
+      if (closed_.load()) {
+        ::close(fd);
+        return fail("transport closed");
+      }
       out_fds_[dest] = fd;
     }
-    if (!write_frame(fd, rank_, tag, data, len))
+    // Register as an in-flight sender so close() shuts the fd down (waking
+    // a blocked write) and waits for us before it ::close()s the descriptor
+    // — same fd-recycling hazard as the in_fds_/reader_loop path.
+    {
+      std::lock_guard<std::mutex> g2(out_mutex_);
+      if (closed_.load()) return fail("transport closed");
+      ++active_sends_;
+    }
+    bool ok = write_frame(fd, rank_, tag, data, len);
+    {
+      std::lock_guard<std::mutex> g2(out_mutex_);
+      --active_sends_;
+    }
+    out_cv_.notify_all();
+    if (!ok)
       return fail("send to peer " + std::to_string(dest) + " failed");
     return true;
   }
@@ -275,7 +294,11 @@ class Transport {
       listen_fd_ = -1;
     }
     {
-      std::lock_guard<std::mutex> g(out_mutex_);
+      // Shut down first (unblocks any sender mid-write; fd stays valid),
+      // drain in-flight senders, then close — never close under a writer.
+      std::unique_lock<std::mutex> g(out_mutex_);
+      for (auto& [dest, fd] : out_fds_) ::shutdown(fd, SHUT_RDWR);
+      out_cv_.wait(g, [&] { return active_sends_ == 0; });
       for (auto& [dest, fd] : out_fds_) ::close(fd);
       out_fds_.clear();
     }
@@ -293,6 +316,16 @@ class Transport {
     if (accept_thread_.joinable()) accept_thread_.join();
     for (auto& t : reader_threads_)
       if (t.joinable()) t.join();
+  }
+
+  // Destroying a joinable std::thread std::terminates the process; if close()
+  // failed partway (e.g. a join threw), detach rather than terminate.  By this
+  // point closed_ is set and every fd is shut down, so the threads are exiting.
+  ~Transport() {
+    closed_.store(true);
+    if (accept_thread_.joinable()) accept_thread_.detach();
+    for (auto& t : reader_threads_)
+      if (t.joinable()) t.detach();
   }
 
   const std::map<int, std::string>& peers() const { return peers_; }
@@ -401,6 +434,7 @@ class Transport {
   int rank_, size_;
   int listen_fd_ = -1;
   int active_recvs_ = 0;  // guarded by inbox_mutex_
+  int active_sends_ = 0;  // guarded by out_mutex_
   std::atomic<bool> closed_{false};
   std::map<int, std::string> peers_;
 
@@ -410,6 +444,7 @@ class Transport {
   std::vector<std::thread> reader_threads_;
 
   std::mutex out_mutex_;
+  std::condition_variable out_cv_;
   std::map<int, int> out_fds_;
   std::map<int, std::mutex> out_locks_;
 
@@ -486,12 +521,16 @@ int64_t dcn_peers(void* handle, char* out, int64_t cap) {
   return static_cast<int64_t>(s.size());
 }
 
-void dcn_close(void* handle) try {
+void dcn_close(void* handle) {
   auto* t = static_cast<Transport*>(handle);
-  t->close();
+  try {
+    t->close();
+  } catch (...) {
+    set_error("native close: unknown C++ exception");
+  }
+  // Always reclaim: the destructor detaches any thread close() failed to
+  // join, so delete cannot std::terminate and the Transport never leaks.
   delete t;
-} catch (...) {
-  set_error("native close: unknown C++ exception");
 }
 
 const char* dcn_last_error() { return g_last_error.c_str(); }

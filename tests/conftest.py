@@ -6,47 +6,32 @@ analogue is an 8-device virtual CPU mesh in one process — "mpiexec -n 8 on
 one box" — over which every communicator runs real XLA collectives.
 
 This image's sitecustomize pre-initializes the TPU backend at interpreter
-startup, so env vars set here would be too late; the conftest therefore
-re-execs pytest once with the right environment (CPU platform, 8 devices,
-axon site dir stripped).
+startup, so env vars set here are too late.  Instead of re-exec'ing (which
+loses output under pytest's fd-level capture), we reset JAX in-process:
+``jax.extend.backend.clear_backends()`` tears down the eagerly-created
+backend and clears the "initialized" latch, after which ``jax_platforms``
+and ``jax_num_cpu_devices`` can be updated normally.
 """
 
-import os
-import sys
-
-_FLAG = "_CHAINERMN_TPU_TEST_REEXEC"
+import jax
 
 
-def _reexec_with_cpu_mesh():
-    env = dict(os.environ)
-    env[_FLAG] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JAX_NUM_CPU_DEVICES"] = "8"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8").strip()
-    # The axon sitecustomize eagerly initializes the TPU backend; drop it.
-    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-             if p and "axon_site" not in p]
-    env["PYTHONPATH"] = os.pathsep.join(parts)
-    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-
-if os.environ.get(_FLAG) != "1":
-    import jax
-
+def _ensure_cpu_mesh(n: int = 8) -> None:
     try:
-        ok = jax.default_backend() == "cpu" and len(jax.devices()) >= 8
+        ok = jax.default_backend() == "cpu" and len(jax.devices()) >= n
     except Exception:
         ok = False
-    if not ok:
-        _reexec_with_cpu_mesh()
+    if ok:
+        return
+    import jax.extend as jex
 
-import jax  # noqa: E402
+    jex.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    assert jax.default_backend() == "cpu" and len(jax.devices()) >= n
 
-try:  # belt and braces for direct invocations that already set the env
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass
+
+_ensure_cpu_mesh()
 
 import pytest  # noqa: E402
 
