@@ -217,6 +217,28 @@ class Transport {
       push(rank_, tag, std::move(payload));
       return true;
     }
+    // Register as an in-flight sender for the WHOLE call — including the
+    // connect phase, which can block for seconds with no fd registered
+    // anywhere close() could shut down.  close()/~Transport wait for
+    // active_sends_ == 0 before the object (out_locks_, out_fds_, peers_)
+    // is torn down; registering only around the write would let close()
+    // return while a connecting sender still holds references into us.
+    struct SendGuard {
+      Transport* t;
+      ~SendGuard() {
+        {
+          std::lock_guard<std::mutex> g(t->out_mutex_);
+          --t->active_sends_;
+        }
+        t->out_cv_.notify_all();
+      }
+    };
+    {
+      std::lock_guard<std::mutex> g(out_mutex_);
+      if (closed_.load()) return fail("transport closed");
+      ++active_sends_;
+    }
+    SendGuard guard{this};
     std::unique_lock<std::mutex> out_guard(out_mutex_);
     auto& lock = out_locks_[dest];  // per-dest serialization
     out_guard.unlock();
@@ -242,21 +264,7 @@ class Transport {
       }
       out_fds_[dest] = fd;
     }
-    // Register as an in-flight sender so close() shuts the fd down (waking
-    // a blocked write) and waits for us before it ::close()s the descriptor
-    // — same fd-recycling hazard as the in_fds_/reader_loop path.
-    {
-      std::lock_guard<std::mutex> g2(out_mutex_);
-      if (closed_.load()) return fail("transport closed");
-      ++active_sends_;
-    }
-    bool ok = write_frame(fd, rank_, tag, data, len);
-    {
-      std::lock_guard<std::mutex> g2(out_mutex_);
-      --active_sends_;
-    }
-    out_cv_.notify_all();
-    if (!ok)
+    if (!write_frame(fd, rank_, tag, data, len))
       return fail("send to peer " + std::to_string(dest) + " failed");
     return true;
   }
@@ -318,14 +326,43 @@ class Transport {
       if (t.joinable()) t.join();
   }
 
-  // Destroying a joinable std::thread std::terminates the process; if close()
-  // failed partway (e.g. a join threw), detach rather than terminate.  By this
-  // point closed_ is set and every fd is shut down, so the threads are exiting.
+  // If close() threw partway, threads may still be blocked on live fds; a
+  // detached thread would then dereference freed members (use-after-free).
+  // Re-run the (idempotent) shutdown passes so every blocked syscall returns,
+  // then join.  Detach only as a last resort if a join itself throws —
+  // destroying a joinable std::thread would std::terminate the process.
   ~Transport() {
     closed_.store(true);
-    if (accept_thread_.joinable()) accept_thread_.detach();
-    for (auto& t : reader_threads_)
-      if (t.joinable()) t.detach();
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    {
+      std::lock_guard<std::mutex> g(out_mutex_);
+      for (auto& [dest, fd] : out_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    {
+      std::lock_guard<std::mutex> g(conn_mutex_);
+      for (int fd : in_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    try {
+      // Drain caller threads still inside send()/recv() (close() may have
+      // thrown before its own drains ran) — they hold references to the
+      // members destroyed right after this returns.
+      {
+        std::unique_lock<std::mutex> g(out_mutex_);
+        out_cv_.wait(g, [&] { return active_sends_ == 0; });
+      }
+      {
+        std::unique_lock<std::mutex> lk(inbox_mutex_);
+        inbox_cv_.notify_all();
+        inbox_cv_.wait(lk, [&] { return active_recvs_ == 0; });
+      }
+      if (accept_thread_.joinable()) accept_thread_.join();
+      for (auto& t : reader_threads_)
+        if (t.joinable()) t.join();
+    } catch (...) {
+      if (accept_thread_.joinable()) accept_thread_.detach();
+      for (auto& t : reader_threads_)
+        if (t.joinable()) t.detach();
+    }
   }
 
   const std::map<int, std::string>& peers() const { return peers_; }
@@ -354,6 +391,17 @@ class Transport {
       }
       {
         std::lock_guard<std::mutex> g(conn_mutex_);
+        // A connection can be accepted concurrently with close(): close()
+        // sets closed_ and shuts down the fds already in in_fds_, but this
+        // fd is not registered yet, so close() would miss it and the reader
+        // spawned for it could block forever.  Re-checking closed_ under
+        // conn_mutex_ closes the window: either close()'s shutdown pass ran
+        // first (we see closed_ and drop the fd) or we register first (the
+        // pass shuts the fd down).
+        if (closed_.load()) {
+          ::close(fd);
+          return;
+        }
         in_fds_.push_back(fd);
         reader_threads_.emplace_back([this, fd] { reader_loop(fd); });
       }

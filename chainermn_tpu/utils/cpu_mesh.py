@@ -1,0 +1,61 @@
+"""Virtual CPU device-mesh bootstrap.
+
+The test/dryrun analogue of the reference's ``mpiexec -n 8`` on one box
+(SURVEY.md §4): an n-device CPU mesh in a single process, over which every
+communicator runs real XLA collectives.
+
+This image's sitecustomize pre-initializes the TPU backend at interpreter
+startup, so ``JAX_PLATFORMS``/``JAX_NUM_CPU_DEVICES`` set later are ignored.
+The only reliable in-process recovery is to tear the backend down
+(``jax.extend.backend.clear_backends()`` clears the "initialized" latch)
+and re-configure.  That fragile sequence lives here, once, shared by
+``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+
+def reset_to_cpu_mesh(n: int) -> None:
+    """Tear down the current JAX backend and bring up ``n`` CPU devices."""
+    import jax
+    import jax.extend as jex
+
+    jex.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    devs = jax.devices()
+    assert jax.default_backend() == "cpu" and len(devs) >= n, (
+        f"CPU mesh bootstrap failed: backend={jax.default_backend()} "
+        f"devices={len(devs)} (wanted >= {n})")
+
+
+def ensure_cpu_mesh(n: int = 8) -> None:
+    """Guarantee a CPU backend with at least ``n`` devices (tests)."""
+    import jax
+
+    try:
+        ok = jax.default_backend() == "cpu" and len(jax.devices()) >= n
+    except Exception:
+        ok = False
+    if not ok:
+        reset_to_cpu_mesh(n)
+
+
+def ensure_device_count(n: int):
+    """Return >= ``n`` devices on the current backend if it already has
+    them (real chips win), else reset to an ``n``-device CPU mesh.
+
+    Guarded against a pre-initialized backend that fails outright (e.g. the
+    TPU plugin present but no chip attached): any error counts as zero
+    devices and triggers the CPU-mesh reset.
+    """
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception:
+        devices = []
+    if len(devices) < n:
+        reset_to_cpu_mesh(n)
+        devices = jax.devices()
+    return devices

@@ -15,23 +15,9 @@ and ``jax_num_cpu_devices`` can be updated normally.
 
 import jax
 
+from chainermn_tpu.utils.cpu_mesh import ensure_cpu_mesh
 
-def _ensure_cpu_mesh(n: int = 8) -> None:
-    try:
-        ok = jax.default_backend() == "cpu" and len(jax.devices()) >= n
-    except Exception:
-        ok = False
-    if ok:
-        return
-    import jax.extend as jex
-
-    jex.backend.clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
-    assert jax.default_backend() == "cpu" and len(jax.devices()) >= n
-
-
-_ensure_cpu_mesh()
+ensure_cpu_mesh(8)
 
 import pytest  # noqa: E402
 

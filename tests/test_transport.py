@@ -125,6 +125,42 @@ class TestNativeTransport:
         assert got and isinstance(got[0], (TimeoutError, OSError))
         tps[1].close()
 
+    def test_close_races_concurrent_senders_receivers(self):
+        """close() during a storm of sends/recvs (including senders still in
+        their connect phase) must neither crash nor hang: in-flight callers
+        are drained, late callers fail cleanly with 'transport closed'."""
+        import time
+
+        from chainermn_tpu.runtime.native import NativeTransport
+
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([lambda r, s, c: NativeTransport(r, s, c)] * 3, coord)
+        stop = time.monotonic() + 2.0
+        errs = []
+
+        def hammer(rank):
+            i = 0
+            while time.monotonic() < stop:
+                try:
+                    tps[rank].send((rank + 1) % 3, 11, b"x" * 4096)
+                    tps[rank].recv((rank - 1) % 3, 11, timeout=0.05)
+                except (TimeoutError, OSError):
+                    pass  # expected once the transport closes under us
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+                i += 1
+
+        ts = [threading.Thread(target=hammer, args=(r,)) for r in range(3)]
+        [t.start() for t in ts]
+        time.sleep(0.5)  # mid-storm
+        [t.close() for t in tps]
+        deadline = time.monotonic() + 30
+        for t in ts:
+            t.join(max(0.1, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in ts), "hammer thread hung"
+        assert not errs, errs
+
     def test_recv_timeout(self):
         from chainermn_tpu.runtime.native import NativeTransport
 
