@@ -104,11 +104,17 @@ def main():
     for i in range(steps):
         params, model_state, opt_state, loss = step(
             params, model_state, opt_state, batch)
+    # Value read, not just block_until_ready: on the tunneled TPU platform
+    # block_until_ready can return before execution finishes; reading the
+    # final loss to host is a fence the donated-buffer dependency chain
+    # guarantees (every step must have run for it to exist).
     jax.block_until_ready(loss)
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
     if args.profile:
         jax.profiler.stop_trace()
         log(f"bench: profile written to {args.profile}")
+    log(f"bench: final loss {final_loss:.3f}")
 
     img_per_sec = global_batch * steps / dt
     per_chip = img_per_sec / n_dev

@@ -66,13 +66,23 @@ def main():
         expect = (n - 1) / 2.0
         np.testing.assert_allclose(
             np.asarray(out[0, :3]), expect, rtol=1e-2)
+        # Per-iteration sync on CPU: piled-up async multi-device executions
+        # can starve XLA's in-process collective rendezvous on few-core hosts.
+        sync_each = jax.default_backend() == "cpu"
+        # A value read is the timing fence: block_until_ready alone can
+        # return early on the tunneled TPU platform in this image.
+        fence = lambda o: float(jnp.sum(o[:, :1]))
         for _ in range(args.warmup):
             out = comm.run_spmd(body, stacked)
-        jax.block_until_ready(out)
+            if sync_each:
+                jax.block_until_ready(out)
+        fence(out)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = comm.run_spmd(body, stacked)
-        jax.block_until_ready(out)
+            if sync_each:
+                jax.block_until_ready(out)
+        fence(out)
         dt = (time.perf_counter() - t0) / args.iters
         payload = n_elems * np.dtype(args.dtype).itemsize
         busbw = 2 * (n - 1) / n * payload / dt / 1e9

@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""The five BASELINE.json benchmark configs, runnable anywhere.
+
+Reference configs (BASELINE.json:configs, SURVEY.md §6):
+
+  1. mnist_mlp        — MNIST MLP data-parallel, naive communicator, CPU
+  2. resnet50_xla     — ResNet-50 ImageNet, xla (pure_nccl analogue), 1 host
+  3. vgg16_cifar_db   — VGG-16/CIFAR-10, double-buffered allreduce optimizer
+  4. seq2seq_mp       — seq2seq model-parallel (MultiNodeChainList send/recv)
+  5. resnet50_hier    — ResNet-50 multi-host (hierarchical comm, ICI x DCN)
+
+Each config prints one JSON line.  Configs that need the accelerator run
+first (2, 3 — real shapes on TPU, reduced on CPU); configs that need
+multiple devices then reset the process to the 8-device virtual CPU mesh
+(the "mpiexec -n 8" analogue, SURVEY.md §4) when the attached backend has
+a single chip.  On a real multi-chip slice everything runs on the slice.
+
+    python benchmarks/run_configs.py                 # all five
+    python benchmarks/run_configs.py --configs mnist_mlp,seq2seq_mp
+    python benchmarks/run_configs.py --out results.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Runnable from a fresh clone without `pip install -e .`.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _sync(state):
+    """Hard synchronization: read the scalar loss (``state[-1]``) to host.
+
+    ``jax.block_until_ready`` alone is NOT trusted here: on the tunneled
+    TPU platform in this image it can return before execution finishes,
+    which once inflated a throughput number ~20x.  A device->host value
+    read cannot lie — the chain of donated-buffer data dependencies means
+    the last step's loss is only available after every step ran.
+    """
+    import jax
+
+    jax.block_until_ready(state)
+    float(state[-1])
+
+
+def _timed(step_fn, state, steps, warmup):
+    """Run ``state = step_fn(state)`` warmup+steps times; return (state, dt).
+
+    Contract: ``state[-1]`` is a scalar (the loss) — it is read back to the
+    host as the fence at each timing boundary (see :func:`_sync`).
+
+    On the virtual CPU mesh every step is synchronized: XLA's in-process CPU
+    collectives deadlock when many multi-device executions pile up in the
+    async dispatch queue on a host with few cores (the rendezvous needs all
+    device threads of one execution to be runnable at once).  On TPU the
+    loop stays fully async — that's where overlap/pipelining is measured.
+    """
+    import jax
+
+    sync_each = jax.default_backend() == "cpu"
+    for _ in range(warmup):
+        state = step_fn(state)
+        if sync_each:
+            jax.block_until_ready(state)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step_fn(state)
+        if sync_each:
+            jax.block_until_ready(state)
+    _sync(state)
+    return state, time.perf_counter() - t0
+
+
+def _need_devices(n):
+    """Ensure >= n devices, resetting to the virtual CPU mesh if needed."""
+    from chainermn_tpu.utils.cpu_mesh import ensure_device_count
+
+    return ensure_device_count(n)
+
+
+def _dp_image_bench(model, comm, *, image, n_classes, per_chip_batch,
+                    steps, warmup, double_buffering, rngs=None):
+    """Shared data-parallel image-training harness (configs 1, 2, 3, 5)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.optimizers import (
+        init_model_state, init_opt_state, make_train_step)
+    from chainermn_tpu.training import put_global_batch
+
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, image, image, 3), jnp.float32))
+    has_state = "batch_stats" in variables
+    params = comm.bcast_data(variables["params"])
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm,
+        double_buffering=double_buffering)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    if has_state:
+        model_state = init_model_state(comm, variables["batch_stats"])
+
+        def loss_fn(p, state, batch):
+            x, y = batch
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": state}, x, train=True,
+                mutable=["batch_stats"], rngs=rngs)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, mutated["batch_stats"]
+
+        step = make_train_step(comm, loss_fn, optimizer,
+                               with_model_state=True)
+    else:
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply({"params": p}, x, rngs=rngs)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        step = make_train_step(comm, loss_fn, optimizer)
+
+    global_batch = per_chip_batch * comm.size
+    rng = np.random.RandomState(0)
+    x = rng.randn(global_batch, image, image, 3).astype(np.float32)
+    y = (rng.rand(global_batch) * n_classes).astype(np.int32)
+    batch = put_global_batch(comm, (x, y))
+
+    if has_state:
+        def one(state):
+            p, ms, os_, _ = state
+            return step(p, ms, os_, batch)
+        state = (params, model_state, opt_state, jnp.zeros(()))
+    else:
+        def one(state):
+            p, os_, _ = state
+            return step(p, os_, batch)
+        state = (params, opt_state, jnp.zeros(()))
+
+    state, dt = _timed(one, state, steps, warmup)
+    loss = float(state[-1])
+    return {
+        "images_per_sec": global_batch * steps / dt,
+        "images_per_sec_per_chip": global_batch * steps / dt / comm.size,
+        "devices": comm.size,
+        "final_loss": round(loss, 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# Config 1: MNIST MLP, naive communicator, CPU (BASELINE configs[0])
+# --------------------------------------------------------------------------
+def bench_mnist_mlp():
+    import jax
+
+    import chainermn_tpu
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.utils.cpu_mesh import ensure_cpu_mesh
+
+    ensure_cpu_mesh(8)  # the config is explicitly "naive communicator on CPU"
+    import jax.numpy as jnp
+    import optax
+
+    from chainermn_tpu.optimizers import init_opt_state, make_train_step
+    from chainermn_tpu.training import put_global_batch
+
+    comm = chainermn_tpu.create_communicator("naive")
+    model = MLP(n_units=1000, n_out=10)   # the reference example's MLP shape
+    x0 = jnp.zeros((1, 784), jnp.float32)
+    params = comm.bcast_data(model.init(jax.random.key(0), x0)["params"])
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply({"params": p}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    step = make_train_step(comm, loss_fn, optimizer)
+    global_batch = 100 * comm.size
+    rng = np.random.RandomState(0)
+    batch = put_global_batch(comm, (
+        rng.randn(global_batch, 784).astype(np.float32),
+        (rng.rand(global_batch) * 10).astype(np.int32)))
+
+    def one(state):
+        p, os_, _ = state
+        return step(p, os_, batch)
+
+    state, dt = _timed(one, (params, opt_state, jnp.zeros(())), 50, 5)
+    return {
+        "config": "mnist_mlp",
+        "metric": "mnist_mlp_naive_cpu_train_throughput",
+        "value": round(global_batch * 50 / dt, 1),
+        "unit": "images/sec",
+        "devices": comm.size,
+        "communicator": "naive",
+        "final_loss": round(float(state[-1]), 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# Config 2: ResNet-50, xla communicator (pure_nccl analogue), single host
+# --------------------------------------------------------------------------
+def bench_resnet50_xla():
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet50, ResNet
+    from chainermn_tpu.models.resnet import BasicBlock
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        kw = dict(image=224, n_classes=1000, per_chip_batch=128,
+                  steps=20, warmup=5)
+    else:
+        model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
+                       num_filters=8, num_classes=10)
+        kw = dict(image=32, n_classes=10, per_chip_batch=8,
+                  steps=5, warmup=2)
+    comm = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
+    r = _dp_image_bench(model, comm, double_buffering=True, **kw)
+    return {
+        "config": "resnet50_xla",
+        "metric": "resnet50_xla_train_throughput" if on_tpu
+                  else "resnet50_xla_cpu_smoke",
+        "value": round(r["images_per_sec_per_chip"], 2),
+        "unit": "images/sec/chip",
+        "devices": r["devices"],
+        "communicator": "xla(bf16)" if on_tpu else "xla",
+        "final_loss": r["final_loss"],
+    }
+
+
+# --------------------------------------------------------------------------
+# Config 3: VGG-16 / CIFAR-10, double-buffered allreduce (configs[2])
+# --------------------------------------------------------------------------
+def bench_vgg16_cifar_db():
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.models import VGG16, VGG
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = VGG16(num_classes=10, dtype=jnp.bfloat16)
+        kw = dict(image=32, n_classes=10, per_chip_batch=256,
+                  steps=20, warmup=5)
+    else:
+        model = VGG(cfg=(16, "M", 32, "M"), hidden=64, num_classes=10)
+        kw = dict(image=32, n_classes=10, per_chip_batch=8,
+                  steps=5, warmup=2)
+    comm = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
+    rngs = {"dropout": jax.random.key(1)}
+    r = _dp_image_bench(model, comm, double_buffering=True, rngs=rngs, **kw)
+    return {
+        "config": "vgg16_cifar_db",
+        "metric": "vgg16_cifar10_double_buffered_train_throughput"
+                  if on_tpu else "vgg16_cifar10_db_cpu_smoke",
+        "value": round(r["images_per_sec_per_chip"], 2),
+        "unit": "images/sec/chip",
+        "devices": r["devices"],
+        "communicator": "xla(bf16)+double_buffering" if on_tpu
+                        else "xla+double_buffering",
+        "final_loss": r["final_loss"],
+    }
+
+
+# --------------------------------------------------------------------------
+# Config 4: seq2seq model-parallel over send/recv (configs[3])
+# --------------------------------------------------------------------------
+def bench_seq2seq_mp():
+    _need_devices(2)
+    import jax
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.links import MultiNodeChainList
+    from chainermn_tpu.models.seq2seq import (
+        Seq2SeqDecoder, Seq2SeqEncoder, make_copy_reverse_task)
+    from chainermn_tpu.optimizers import create_per_stage_optimizer
+
+    batch, seq_len, vocab, hidden = 128, 16, 32, 128
+    steps, warmup = 20, 3
+
+    comm = chainermn_tpu.create_communicator("xla")
+    model = MultiNodeChainList(comm)
+    model.add_link(Seq2SeqEncoder(vocab, hidden=hidden),
+                   rank_in=None, rank_out=1)
+    model.add_link(Seq2SeqDecoder(vocab, hidden=hidden),
+                   rank_in=0, rank_out=None)
+
+    src, tgt_in, tgt = make_copy_reverse_task(batch, seq_len, vocab)
+    params = model.init(jax.random.key(0), src,
+                        stage_inputs={1: (tgt_in,)})
+    opt = create_per_stage_optimizer(optax.adam(2e-3))
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        logits = model.apply(p, src, stage_inputs={1: (tgt_in,)})
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def one(state):
+        p, s, _ = state
+        loss, grads = grad_fn(p)
+        p, s = opt.update(grads, s, p)
+        return p, s, loss
+
+    state, dt = _timed(one, (params, opt_state, None), steps, warmup)
+    tokens = batch * 2 * seq_len  # src + tgt tokens per step
+    return {
+        "config": "seq2seq_mp",
+        "metric": "seq2seq_model_parallel_throughput",
+        "value": round(tokens * steps / dt, 1),
+        "unit": "tokens/sec",
+        "devices": comm.size,
+        "communicator": "xla send/recv (MultiNodeChainList, 2 stages)",
+        "final_loss": round(float(state[-1]), 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# Config 5: ResNet-50 multi-chip, hierarchical (ICI x DCN) (configs[4])
+# --------------------------------------------------------------------------
+def bench_resnet50_hier():
+    devices = _need_devices(4)
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet50, ResNet
+    from chainermn_tpu.models.resnet import BasicBlock
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = len(devices)
+    if on_tpu and n >= 4:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        kw = dict(image=224, n_classes=1000, per_chip_batch=128,
+                  steps=20, warmup=5)
+    else:
+        model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
+                       num_filters=8, num_classes=10)
+        kw = dict(image=32, n_classes=10, per_chip_batch=8,
+                  steps=5, warmup=2)
+    comm = chainermn_tpu.create_communicator("hierarchical", intra_size=n // 2)
+    r = _dp_image_bench(model, comm, double_buffering=True, **kw)
+    return {
+        "config": "resnet50_hier",
+        "metric": "resnet50_hierarchical_multichip_train_throughput"
+                  if on_tpu else "resnet50_hierarchical_virtual_mesh_smoke",
+        "value": round(r["images_per_sec_per_chip"], 2),
+        "unit": "images/sec/chip",
+        "devices": r["devices"],
+        "communicator": f"hierarchical (inter=2 x intra={n // 2})",
+        "final_loss": r["final_loss"],
+    }
+
+
+# TPU-needing configs first: multi-device configs may reset the process to
+# the virtual CPU mesh, after which the accelerator backend is gone.
+_CONFIGS = [
+    ("resnet50_xla", bench_resnet50_xla),
+    ("vgg16_cifar_db", bench_vgg16_cifar_db),
+    ("mnist_mlp", bench_mnist_mlp),
+    ("seq2seq_mp", bench_seq2seq_mp),
+    ("resnet50_hier", bench_resnet50_hier),
+]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--configs", default=None,
+                        help="comma-separated subset (default: all five)")
+    parser.add_argument("--out", default=None,
+                        help="also write results to this JSON file")
+    args = parser.parse_args()
+    wanted = args.configs.split(",") if args.configs else [
+        name for name, _ in _CONFIGS]
+    unknown = set(wanted) - {name for name, _ in _CONFIGS}
+    if unknown:
+        parser.error(f"unknown configs: {sorted(unknown)}; "
+                     f"available: {[n for n, _ in _CONFIGS]}")
+
+    import jax
+
+    results = []
+    for name, fn in _CONFIGS:
+        if name not in wanted:
+            continue
+        log(f"config {name}: starting "
+            f"(backend={jax.default_backend()}, "
+            f"devices={jax.device_count()})")
+        t0 = time.perf_counter()
+        row = fn()
+        row["wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+        log(f"wrote {args.out}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,10 @@ _EXPORTS = {
     "DATA_AXES": "chainermn_tpu.parallel.topology",
     "INTER_AXIS": "chainermn_tpu.parallel.topology",
     "INTRA_AXIS": "chainermn_tpu.parallel.topology",
+    # sequence/context parallelism (beyond-reference extension)
+    "attention": "chainermn_tpu.parallel.sequence",
+    "ring_attention": "chainermn_tpu.parallel.sequence",
+    "ulysses_attention": "chainermn_tpu.parallel.sequence",
 }
 
 __all__ = sorted(_EXPORTS)

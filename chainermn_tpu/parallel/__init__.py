@@ -1,0 +1,34 @@
+"""Mesh topology and parallelism strategies.
+
+``topology`` — the rank/axis bookkeeping every communicator builds on
+(the reference's 〔_communication_utility.py〕 role).  ``sequence`` —
+sequence/context parallelism (ring + Ulysses attention), a beyond-reference
+extension for long-context training (SURVEY.md §5.7 records the reference
+has none).
+"""
+
+from chainermn_tpu.parallel.topology import (
+    DATA_AXES,
+    INTER_AXIS,
+    INTRA_AXIS,
+    Topology,
+    init_topology,
+    topology_from_mesh,
+)
+from chainermn_tpu.parallel.sequence import (
+    attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "DATA_AXES",
+    "INTER_AXIS",
+    "INTRA_AXIS",
+    "Topology",
+    "attention",
+    "init_topology",
+    "ring_attention",
+    "topology_from_mesh",
+    "ulysses_attention",
+]

@@ -103,10 +103,15 @@ bool read_frame(int fd, uint32_t* src, uint32_t* tag, std::string* payload) {
   return true;
 }
 
-int connect_to(const std::string& host, int port, double timeout_s) {
+int connect_to(const std::string& host, int port, double timeout_s,
+               const std::atomic<bool>* cancel = nullptr) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(timeout_s);
   for (;;) {
+    // Abort promptly when the owning transport is closing: a sender stuck
+    // retrying an unreachable peer must not pin close() for the full
+    // connect timeout via the in-flight drain.
+    if (cancel && cancel->load()) return -1;
     struct addrinfo hints {};
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -255,7 +260,8 @@ class Transport {
       if (it == peers_.end()) return fail("unknown peer " + std::to_string(dest));
       auto colon = it->second.rfind(':');
       fd = connect_to(it->second.substr(0, colon),
-                      std::stoi(it->second.substr(colon + 1)), 30.0);
+                      std::stoi(it->second.substr(colon + 1)), 30.0,
+                      &closed_);
       if (fd < 0) return fail("connect to peer " + std::to_string(dest) + " failed");
       std::lock_guard<std::mutex> g2(out_mutex_);
       if (closed_.load()) {
@@ -569,16 +575,29 @@ int64_t dcn_peers(void* handle, char* out, int64_t cap) {
   return static_cast<int64_t>(s.size());
 }
 
-void dcn_close(void* handle) {
+// Two-phase teardown for callers that may still have threads inside
+// dcn_send/dcn_recv: dcn_shutdown drains and unblocks them WITHOUT freeing
+// (safe to call while they are in flight — it is what makes them return),
+// the caller then waits for its own in-flight count to reach zero, and
+// only then dcn_destroy frees the object.  The Python binding does exactly
+// this; calling dcn_destroy with callers still inside is a use-after-free.
+void dcn_shutdown(void* handle) {
   auto* t = static_cast<Transport*>(handle);
   try {
     t->close();
   } catch (...) {
     set_error("native close: unknown C++ exception");
   }
-  // Always reclaim: the destructor detaches any thread close() failed to
-  // join, so delete cannot std::terminate and the Transport never leaks.
-  delete t;
+}
+
+void dcn_destroy(void* handle) { delete static_cast<Transport*>(handle); }
+
+// One-shot close-and-free, kept for single-threaded callers.  The
+// destructor re-runs the shutdown passes and drains registered callers,
+// but cannot protect a caller that has not yet entered the counter.
+void dcn_close(void* handle) {
+  dcn_shutdown(handle);
+  dcn_destroy(handle);
 }
 
 const char* dcn_last_error() { return g_last_error.c_str(); }

@@ -65,13 +65,24 @@ def _load() -> ctypes.CDLL:
             lib.dcn_peers.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_int64]
             lib.dcn_close.argtypes = [ctypes.c_void_p]
+            lib.dcn_shutdown.argtypes = [ctypes.c_void_p]
+            lib.dcn_destroy.argtypes = [ctypes.c_void_p]
             lib.dcn_last_error.restype = ctypes.c_char_p
             _lib = lib
     return _lib
 
 
 class NativeTransport:
-    """Same surface as ``PyTransport`` (send/recv/close/peers), C++ core."""
+    """Same surface as ``PyTransport`` (send/recv/close/peers), C++ core.
+
+    Lifetime safety: every FFI call into the handle is bracketed by an
+    in-flight counter.  ``close()`` (a) marks the transport closed so new
+    callers fail fast with OSError, (b) runs the native shutdown — which
+    is what unblocks callers already inside ``dcn_send``/``dcn_recv`` —
+    (c) waits for the in-flight count to reach zero, and only then (d)
+    frees the native object.  Without (c)/(d) split a concurrent caller
+    could touch freed memory (use-after-free).
+    """
 
     def __init__(self, rank: int, size: int, coordinator: str):
         lib = _load()
@@ -87,38 +98,73 @@ class NativeTransport:
                 f"{lib.dcn_last_error().decode()}")
         self._handle = handle
         self._closed = False
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def _enter(self):
+        with self._cv:
+            if self._closed:
+                raise OSError("transport closed")
+            self._inflight += 1
+
+    def _exit(self):
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
 
     @property
     def peers(self):
         import json
 
-        buf = ctypes.create_string_buffer(65536)
-        n = self._lib.dcn_peers(self._handle, buf, len(buf))
-        if n < 0:
-            raise OSError("peer table too large")
-        return {int(r): a for r, a in json.loads(buf.value.decode())}
+        self._enter()
+        try:
+            buf = ctypes.create_string_buffer(65536)
+            n = self._lib.dcn_peers(self._handle, buf, len(buf))
+            if n < 0:
+                raise OSError("peer table too large")
+            return {int(r): a for r, a in json.loads(buf.value.decode())}
+        finally:
+            self._exit()
 
     def send(self, dest: int, tag: int, payload: bytes):
-        rc = self._lib.dcn_send(self._handle, dest, tag, payload,
-                                len(payload))
-        if rc != 0:
-            raise OSError(f"native send failed: "
-                          f"{self._lib.dcn_last_error().decode()}")
+        self._enter()
+        try:
+            rc = self._lib.dcn_send(self._handle, dest, tag, payload,
+                                    len(payload))
+            if rc != 0:
+                raise OSError(f"native send failed: "
+                              f"{self._lib.dcn_last_error().decode()}")
+        finally:
+            self._exit()
 
     def recv(self, source: int, tag: int, timeout: float = 300.0) -> bytes:
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        n = self._lib.dcn_recv(self._handle, source, tag, timeout,
-                               ctypes.byref(out))
-        if n < 0:
-            raise TimeoutError(
-                f"native recv from rank {source} (tag {tag}): "
-                f"{self._lib.dcn_last_error().decode()}")
+        self._enter()
         try:
-            return ctypes.string_at(out, n)
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.dcn_recv(self._handle, source, tag, timeout,
+                                   ctypes.byref(out))
+            if n < 0:
+                raise TimeoutError(
+                    f"native recv from rank {source} (tag {tag}): "
+                    f"{self._lib.dcn_last_error().decode()}")
+            try:
+                return ctypes.string_at(out, n)
+            finally:
+                self._lib.dcn_free(out)
         finally:
-            self._lib.dcn_free(out)
+            self._exit()
 
     def close(self):
-        if not self._closed:
-            self._closed = True
-            self._lib.dcn_close(self._handle)
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True  # new callers now fail fast in _enter
+        # Shutdown unblocks in-flight callers (fd shutdown + cv wakeups);
+        # it must run BEFORE waiting on them, or a blocked recv would pin
+        # close() for its full timeout.
+        self._lib.dcn_shutdown(self._handle)
+        with self._cv:
+            while self._inflight:
+                self._cv.wait()
+        self._lib.dcn_destroy(self._handle)

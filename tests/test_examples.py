@@ -1,0 +1,68 @@
+"""Example scripts run unchanged — the reference's end-user surface.
+
+Reference strategy analogue (SURVEY.md §4): the examples ARE the contract
+(`mpiexec -n N python train_*.py --communicator ...`); here each stock
+script runs as a subprocess on the 8-device virtual CPU mesh with tiny
+shapes.  MNIST is covered in test_training.py; these cover the rest of the
+example tree (BASELINE.json configs 2-5's script shapes).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = "8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_cifar_double_buffered(tmp_path):
+    """VGG/CIFAR with the double-buffered optimizer (configs[2] shape)."""
+    out = _run("cifar/train_cifar.py",
+               "--epoch", "1", "--batchsize", "32", "--train-size", "256",
+               "--double-buffering", "--dtype", "float32",
+               "--out", str(tmp_path))
+    assert "epoch" in out.lower() or "loss" in out.lower()
+
+
+@pytest.mark.slow
+def test_imagenet_tiny(tmp_path):
+    """ImageNet script with a small arch + synthetic data (configs[1] shape)."""
+    out = _run("imagenet/train_imagenet.py",
+               "--arch", "nin", "--epoch", "1", "--batchsize", "16",
+               "--train-size", "64", "--image-size", "64",
+               "--n-classes", "10", "--dtype", "float32",
+               "--out", str(tmp_path))
+    assert "loss" in out.lower() or "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_seq2seq_model_parallel():
+    """Encoder/decoder on separate stages via send/recv (configs[3])."""
+    out = _run("seq2seq/seq2seq.py",
+               "--epoch", "2", "--batchsize", "64", "--n-train", "256",
+               "--seq-len", "8", "--hidden", "32")
+    assert "token-acc" in out or "token_accuracy" in out
+
+
+@pytest.mark.slow
+def test_parallel_convolution():
+    """Channel-split conv demo (the reference's parallel_convolution)."""
+    out = _run("parallel_convolution/train_parallel_conv.py",
+               "--steps", "10", "--batchsize", "8")
+    assert "loss" in out.lower() or "step" in out.lower()

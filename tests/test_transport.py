@@ -161,6 +161,35 @@ class TestNativeTransport:
         assert not any(t.is_alive() for t in ts), "hammer thread hung"
         assert not errs, errs
 
+    def test_close_aborts_sender_stuck_connecting(self):
+        """close() must not wait out the 30s connect-retry loop of a sender
+        whose peer is gone — the retry loop checks the closed flag."""
+        import time
+
+        from chainermn_tpu.runtime.native import NativeTransport
+
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([lambda r, s, c: NativeTransport(r, s, c)] * 2, coord)
+        tps[1].close()  # peer gone: rank 0's connect will be refused+retried
+        errs = []
+
+        def doomed_send():
+            try:
+                tps[0].send(1, 5, b"into the void")
+            except OSError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=doomed_send)
+        t.start()
+        time.sleep(0.3)  # let it enter the connect-retry loop
+        t0 = time.monotonic()
+        tps[0].close()
+        closed_in = time.monotonic() - t0
+        t.join(10)
+        assert not t.is_alive(), "sender never unblocked"
+        assert closed_in < 5.0, f"close() hung {closed_in:.1f}s on a connecting sender"
+        assert errs, "send into closed world should have raised"
+
     def test_recv_timeout(self):
         from chainermn_tpu.runtime.native import NativeTransport
 
