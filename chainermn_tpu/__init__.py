@@ -27,6 +27,7 @@ _EXPORTS = {
     "create_multi_node_checkpointer": "chainermn_tpu.extensions",
     "create_multi_node_iterator": "chainermn_tpu.iterators",
     "create_synchronized_iterator": "chainermn_tpu.iterators",
+    "MultiNodeBatchNormalization": "chainermn_tpu.links",
     "MultiNodeChainList": "chainermn_tpu.links",
     "init_topology": "chainermn_tpu.parallel.topology",
     "Topology": "chainermn_tpu.parallel.topology",
@@ -37,6 +38,9 @@ _EXPORTS = {
     "attention": "chainermn_tpu.parallel.sequence",
     "ring_attention": "chainermn_tpu.parallel.sequence",
     "ulysses_attention": "chainermn_tpu.parallel.sequence",
+    # micro-batch pipeline parallelism (beyond-reference extension)
+    "pipeline_apply": "chainermn_tpu.parallel.pipeline",
+    "make_pipeline_fn": "chainermn_tpu.parallel.pipeline",
 }
 
 __all__ = sorted(_EXPORTS)

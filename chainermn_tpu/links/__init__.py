@@ -1,3 +1,6 @@
 from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+from chainermn_tpu.links.multi_node_batch_normalization import (
+    MultiNodeBatchNormalization,
+)
 
-__all__ = ["MultiNodeChainList"]
+__all__ = ["MultiNodeBatchNormalization", "MultiNodeChainList"]

@@ -1,0 +1,69 @@
+"""Synchronized (multi-node) batch normalization.
+
+Reference being rebuilt (path unverified, SURVEY.md provenance):
+``MultiNodeBatchNormalization`` in 〔chainermn/links/batch_normalization.py〕
+(upstream ChainerMN v1.2/1.3 era — the fork's era): BatchNorm whose batch
+mean/variance are computed over the GLOBAL batch via an allreduce across
+ranks, instead of each rank's local slice.  The reference implemented the
+cross-rank moment reduction with ``comm.allreduce`` inside the link's
+forward.
+
+TPU-native form: flax's ``nn.BatchNorm`` already reduces its batch moments
+with ``lax.pmean(..., axis_name)`` when given mesh axis names — exactly the
+collective the reference hand-rolled.  This wrapper binds a communicator's
+data axes to that parameter, so inside ``make_train_step`` /
+``comm.run_spmd`` the normalization statistics are global-batch statistics.
+
+Semantics note (SURVEY.md §7 hard part 5): the model zoo's default BN is
+*local* + ``AllreducePersistent`` for checkpoint-time sync — the
+reference's default training recipe.  Use this link where the reference
+would use ``MultiNodeBatchNormalization`` (small per-rank batches where
+local statistics are too noisy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+
+
+def MultiNodeBatchNormalization(
+    communicator=None,
+    *,
+    axis_name: Optional[Union[str, Sequence[str]]] = None,
+    use_running_average: Optional[bool] = None,
+    momentum: float = 0.9,
+    epsilon: float = 2e-5,
+    dtype: Any = None,
+    **kwargs,
+) -> nn.BatchNorm:
+    """Build a BatchNorm whose batch statistics are reduced across the
+    communicator's data axes (reference signature:
+    ``MultiNodeBatchNormalization(size, comm, decay, eps, ...)`` — the
+    size is implied by the normalized feature axis here, and ``decay``/
+    ``eps`` keep their reference defaults 0.9 / 2e-5).
+
+    Exactly one of ``communicator`` / ``axis_name`` must be given.  The
+    returned module only performs the cross-device reduction when applied
+    inside an SPMD region where those axes are bound (``run_spmd`` /
+    ``make_train_step``); applied outside one, flax raises on the unbound
+    axis name — same failure mode as calling the reference's link without
+    an initialized communicator.
+    """
+    if (communicator is None) == (axis_name is None):
+        raise ValueError(
+            "pass exactly one of communicator= or axis_name=")
+    axes = tuple(communicator.data_axes) if communicator is not None \
+        else axis_name
+    return nn.BatchNorm(
+        use_running_average=use_running_average,
+        momentum=momentum,
+        epsilon=epsilon,
+        dtype=dtype,
+        axis_name=axes,
+        **kwargs,
+    )
+
+
+__all__ = ["MultiNodeBatchNormalization"]

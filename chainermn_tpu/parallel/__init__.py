@@ -20,6 +20,10 @@ from chainermn_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+from chainermn_tpu.parallel.pipeline import (
+    make_pipeline_fn,
+    pipeline_apply,
+)
 
 __all__ = [
     "DATA_AXES",
@@ -28,6 +32,8 @@ __all__ = [
     "Topology",
     "attention",
     "init_topology",
+    "make_pipeline_fn",
+    "pipeline_apply",
     "ring_attention",
     "topology_from_mesh",
     "ulysses_attention",

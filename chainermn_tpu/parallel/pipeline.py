@@ -1,0 +1,129 @@
+"""SPMD micro-batch pipeline parallelism (GPipe-style schedule).
+
+**Beyond-reference extension.** The reference's model parallelism
+(``MultiNodeChainList``, SURVEY.md §2.4) keeps exactly ONE activation in
+flight — a pipeline of depth 1, stages idle while their neighbors work.
+This module adds the standard micro-batch schedule on top of the same
+mesh machinery: split the batch into M micro-batches and keep all S
+stages busy after the (S-1)-tick fill bubble — utilization M/(M+S-1).
+
+TPU-native shape: the schedule is a single ``lax.scan`` over
+S + M - 1 ticks inside ``shard_map``; every tick, each device runs ITS
+stage on the activation it holds and ``ppermute``-s the result one hop to
+the next stage — nearest-neighbor traffic that maps directly onto the ICI
+torus.  All stages execute the same ``stage_fn`` (homogeneous-stage SPMD
+pipelining, the form XLA compiles to one program); heterogeneous chains
+stay on ``MultiNodeChainList``.
+
+Differentiable end to end: the backward of the scan re-runs the schedule
+reversed (``ppermute`` transposes to the opposite shift), which is exactly
+the reference-free derivation of pipeline backprop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.utils import axis_size as _axis_size, pvary
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    axis_name,
+    *,
+    collect: str = "all_gather",
+):
+    """Run a homogeneous S-stage pipeline over micro-batches, SPMD.
+
+    Per device (inside ``shard_map`` with ``axis_name`` bound):
+
+    - ``stage_params`` — THIS device's stage parameters (device-varying
+      pytree; shard a stacked [S, ...] tree over the pipeline axis).
+    - ``x`` — the full micro-batch stack [M, mb, ...], same on every
+      device (replicated in_spec).
+    - ``stage_fn(params, activation) -> activation`` — one stage.
+
+    Returns the last stage's outputs [M, mb, ...] on every device
+    (``collect="all_gather"``), or zeros everywhere but the last stage
+    (``collect="last"`` — cheaper when only the final stage computes the
+    loss).
+
+    Schedule: tick t feeds micro-batch t into stage 0; stage s runs
+    micro-batch t - s at tick t; outputs emerge at ticks S-1 .. S+M-2.
+    """
+    if collect not in ("all_gather", "last"):
+        raise ValueError(f"collect must be 'all_gather' or 'last', "
+                         f"got {collect!r}")
+    size = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    m = x.shape[0]
+    ticks = size + m - 1
+
+    x = pvary(x, axis_name)
+    zero_act = jnp.zeros_like(x[0])
+
+    def tick(act, t):
+        # stage 0 ingests micro-batch t (clamped; invalid ticks produce
+        # bubble values that never reach a collected output)
+        fed = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, m - 1), 0,
+                                       keepdims=False)
+        inp = jnp.where(me == 0, fed, act)
+        y = stage_fn(stage_params, inp)
+        # shift one hop toward the next stage; stage 0 receives zeros
+        # (it reads from x), the last stage's output leaves the ring here
+        # and is collected from the scan's per-tick outputs instead.
+        nxt = lax.ppermute(y, axis_name,
+                           perm=[(i, i + 1) for i in range(size - 1)])
+        return nxt, y
+
+    _, ys = lax.scan(tick, zero_act, jnp.arange(ticks))
+    # ys: [ticks, mb, ...]; on the LAST stage, ticks S-1 .. S+M-2 hold the
+    # pipeline outputs for micro-batches 0 .. M-1.
+    outs = lax.dynamic_slice_in_dim(ys, size - 1, m, axis=0)
+    if collect == "last":
+        return jnp.where(me == size - 1, outs, jnp.zeros_like(outs))
+    # broadcast the last stage's outputs to every device: zero elsewhere,
+    # then sum around the ring (cheap: one psum of the output tensor).
+    masked = jnp.where(me == size - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(masked, axis_name)
+
+
+def make_pipeline_fn(
+    stage_fn: Callable,
+    mesh,
+    axis_name: str = "pp",
+    *,
+    n_microbatches: int,
+):
+    """Jit-ready wrapper: returns ``fn(stacked_params, batch) -> out``.
+
+    ``stacked_params`` — pytree with leading axis S (one slice per stage),
+    sharded over ``axis_name``.  ``batch`` — [B, ...] global batch,
+    B divisible by ``n_microbatches``; replicated to all stages.  The
+    output is the last stage's result, replicated (all-gather collect, so
+    the replicated out_spec holds).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def fn(stacked_params, batch):
+        def body(params_stacked, xb):
+            local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_stacked)
+            mb = xb.reshape((n_microbatches, -1) + xb.shape[1:])
+            out = pipeline_apply(stage_fn, local, mb, axis_name)
+            return out.reshape((-1,) + out.shape[2:])
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis_name), P()),
+            out_specs=P())(stacked_params, batch)
+
+    return jax.jit(fn)
+
+
+__all__ = ["pipeline_apply", "make_pipeline_fn"]

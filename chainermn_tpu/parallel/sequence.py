@@ -43,11 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-def _axis_size(axis_name) -> int:
-    if hasattr(lax, "axis_size"):
-        return lax.axis_size(axis_name)
-    return lax.psum(1, axis_name)
+from chainermn_tpu.utils import axis_size as _axis_size
 
 
 def attention(q, k, v, *, causal: bool = False, sm_scale: Optional[float] = None,

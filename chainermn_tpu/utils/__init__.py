@@ -16,4 +16,12 @@ def pvary(x, axes):
     return jax.lax.pvary(x, axes)
 
 
-__all__ = ["pvary"]
+def axis_size(axis_name) -> int:
+    """Size of a bound mesh axis (``lax.axis_size`` where available, else
+    the ``psum(1)`` idiom older jax versions require)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["axis_size", "pvary"]

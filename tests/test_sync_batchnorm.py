@@ -1,0 +1,97 @@
+"""MultiNodeBatchNormalization: batch stats are GLOBAL-batch statistics.
+
+Reference strategy (SURVEY.md §4): the synchronized link applied to
+rank-local batch slices must match plain BatchNorm applied to the whole
+concatenated batch in one process.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.links import MultiNodeBatchNormalization
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("hierarchical", intra_size=4)
+
+
+def _global_and_local(comm, seed=0):
+    rng = np.random.RandomState(seed)
+    # per-rank slices with DIFFERENT distributions so local != global stats
+    per = np.stack([rng.randn(4, 6).astype(np.float32) * (r + 1) + r
+                    for r in range(comm.size)])
+    return jnp.asarray(per)   # [size, 4, 6]
+
+
+def test_stats_match_concatenated_single_device(comm):
+    stacked = _global_and_local(comm)
+    bn_sync = MultiNodeBatchNormalization(comm, use_running_average=False)
+    variables = bn_sync.init(jax.random.key(0), stacked[0])
+
+    def body(x):
+        y, _ = bn_sync.apply(variables, x, mutable=["batch_stats"])
+        return y
+
+    got = comm.run_spmd(body, stacked)                # [size, 4, 6]
+
+    bn_ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=2e-5)
+    ref_vars = bn_ref.init(jax.random.key(0), stacked[0])
+    want, _ = bn_ref.apply(ref_vars, stacked.reshape(-1, 6),
+                           mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(-1, 6), np.asarray(want),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_local_bn_differs_sync_bn_matches(comm):
+    """Sanity: plain (local) BN on the same slices does NOT reproduce the
+    global normalization — i.e. the collective actually changes the math."""
+    stacked = _global_and_local(comm, seed=1)
+    bn_local = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                            epsilon=2e-5)
+    variables = bn_local.init(jax.random.key(0), stacked[0])
+
+    def body(x):
+        y, _ = bn_local.apply(variables, x, mutable=["batch_stats"])
+        return y
+
+    got_local = comm.run_spmd(body, stacked)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=2e-5)
+    ref_vars = ref.init(jax.random.key(0), stacked[0])
+    want, _ = ref.apply(ref_vars, stacked.reshape(-1, 6),
+                        mutable=["batch_stats"])
+    assert not np.allclose(np.asarray(got_local).reshape(-1, 6),
+                           np.asarray(want), atol=1e-3)
+
+
+def test_running_average_updates_with_global_moments(comm):
+    stacked = _global_and_local(comm, seed=2)
+    bn = MultiNodeBatchNormalization(comm, use_running_average=False)
+    variables = bn.init(jax.random.key(0), stacked[0])
+
+    def body(x):
+        y, mut = bn.apply(variables, x, mutable=["batch_stats"])
+        return mut["batch_stats"]["mean"]
+
+    means = np.asarray(comm.run_spmd(body, stacked))  # [size, 6]
+    # every rank's updated running mean must be identical (global moments)
+    for r in range(1, comm.size):
+        np.testing.assert_allclose(means[r], means[0], rtol=1e-5)
+    # and equal to momentum-blended global batch mean
+    global_mean = np.asarray(stacked).reshape(-1, 6).mean(0)
+    np.testing.assert_allclose(means[0], 0.1 * global_mean, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_requires_exactly_one_binding():
+    with pytest.raises(ValueError, match="exactly one"):
+        MultiNodeBatchNormalization()
+    with pytest.raises(ValueError, match="exactly one"):
+        comm = chainermn_tpu.create_communicator("xla")
+        MultiNodeBatchNormalization(comm, axis_name="sp")
