@@ -29,6 +29,7 @@ _EXPORTS = {
     "create_synchronized_iterator": "chainermn_tpu.iterators",
     "MultiNodeBatchNormalization": "chainermn_tpu.links",
     "MultiNodeChainList": "chainermn_tpu.links",
+    "init_distributed": "chainermn_tpu.runtime.bootstrap",
     "init_topology": "chainermn_tpu.parallel.topology",
     "Topology": "chainermn_tpu.parallel.topology",
     "DATA_AXES": "chainermn_tpu.parallel.topology",

@@ -121,6 +121,12 @@ class MeshCommunicator(CommunicatorBase):
     def host_size(self) -> int:
         return self._cp.size
 
+    @property
+    def host_rank(self) -> int:
+        """Controller-process rank — alias of :attr:`rank` (which is already
+        host-granular; device-level position is :meth:`axis_index`)."""
+        return self._cp.rank
+
     def _local_coords(self) -> Tuple[int, int]:
         """(inter, intra) grid coordinates of this host's first device."""
         grid = self._mesh.devices

@@ -1,0 +1,90 @@
+"""Multi-controller bootstrap — ``jax.distributed`` with no launcher.
+
+Reference analogue (SURVEY.md §2.5, §3.1): the reference's world came from
+``mpiexec -n N`` + ``MPI.COMM_WORLD``; the north star
+(`BASELINE.json:north_star`) replaces that with "one controller process per
+TPU host, topology from TPU slice metadata, no MPI launcher in the loop".
+
+One env contract covers the whole stack (shared with
+:mod:`chainermn_tpu.runtime.control_plane`):
+
+    CHAINERMN_TPU_COORDINATOR=host:port   rank-0 host
+    CHAINERMN_TPU_NUM_PROCESSES=N
+    CHAINERMN_TPU_PROCESS_ID=r
+
+``init_distributed()`` wires ``jax.distributed.initialize`` from it —
+the JAX coordination service listens on ``port + 1`` (the control plane
+owns ``port``).  On real TPU slices the arguments can be omitted
+entirely: ``jax.distributed.initialize()`` discovers everything from
+slice metadata, which IS the no-launcher path.  On CPU it also selects
+gloo cross-process collectives so the multi-controller tests/examples
+run on any machine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_count: Optional[int] = None,
+) -> None:
+    """Initialize the JAX multi-controller runtime from args or env.
+
+    No-op when neither args, env, nor TPU metadata indicate a
+    multi-process world (single-controller remains the default).
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get("CHAINERMN_TPU_COORDINATOR")
+    if num_processes is None:
+        n = os.environ.get("CHAINERMN_TPU_NUM_PROCESSES")
+        num_processes = int(n) if n else None
+    if process_id is None:
+        r = os.environ.get("CHAINERMN_TPU_PROCESS_ID")
+        process_id = int(r) if r else None
+
+    # IMPORTANT: nothing in this function may query the backend
+    # (jax.devices()/default_backend()) before initialize() — that would
+    # initialize XLA and make jax.distributed.initialize() fail.
+    if coordinator is None and num_processes is None:
+        # TPU pod path: `jax.distributed.initialize()` with no args reads
+        # slice metadata.  Attempt it only when the configured platform
+        # looks like TPU; off-TPU stay single-controller.
+        platforms = (os.environ.get("JAX_PLATFORMS")
+                     or getattr(jax.config, "jax_platforms", None) or "")
+        if "tpu" in platforms:
+            try:
+                jax.distributed.initialize()
+            except Exception:
+                pass  # single host / already initialized
+        return
+
+    if coordinator is None or num_processes is None or process_id is None:
+        raise ValueError(
+            "multi-process bootstrap needs coordinator, num_processes and "
+            "process_id (args or CHAINERMN_TPU_* env)")
+
+    host, port = coordinator.rsplit(":", 1)
+    jax_coord = f"{host}:{int(port) + 1}"   # control plane owns `port`
+
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or getattr(jax.config, "jax_platforms", None) or "")
+    if not platforms or platforms.startswith("cpu"):
+        # cross-process CPU collectives (the tests' multi-host analogue)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if local_device_count is not None:
+        jax.config.update("jax_num_cpu_devices", local_device_count)
+
+    jax.distributed.initialize(
+        coordinator_address=jax_coord,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+__all__ = ["init_distributed"]
