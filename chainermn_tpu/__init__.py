@@ -42,6 +42,8 @@ _EXPORTS = {
     # micro-batch pipeline parallelism (beyond-reference extension)
     "pipeline_apply": "chainermn_tpu.parallel.pipeline",
     "make_pipeline_fn": "chainermn_tpu.parallel.pipeline",
+    # fused Pallas kernels
+    "flash_attention": "chainermn_tpu.ops.flash_attention",
 }
 
 __all__ = sorted(_EXPORTS)

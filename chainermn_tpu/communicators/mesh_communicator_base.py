@@ -125,7 +125,7 @@ class MeshCommunicator(CommunicatorBase):
     def host_rank(self) -> int:
         """Controller-process rank — alias of :attr:`rank` (which is already
         host-granular; device-level position is :meth:`axis_index`)."""
-        return self._cp.rank
+        return self.rank
 
     def _local_coords(self) -> Tuple[int, int]:
         """(inter, intra) grid coordinates of this host's first device."""

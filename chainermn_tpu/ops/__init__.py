@@ -1,3 +1,7 @@
-from chainermn_tpu.ops.cast_scale import cast_scale
+"""Native/fused TPU kernels (Pallas) — the reference's CUDA-kernel role
+(SURVEY.md §2.3)."""
 
-__all__ = ["cast_scale"]
+from chainermn_tpu.ops.cast_scale import cast_scale
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["cast_scale", "flash_attention"]

@@ -1,0 +1,226 @@
+"""Fused attention forward — Pallas TPU kernel (flash-attention style).
+
+**Beyond-reference native kernel** (the reference's native surface was
+CUDA elementwise strings — SURVEY.md §2.3; this is the TPU analogue for
+the attention hot op used by the sequence-parallel extension).
+
+One `pallas_call` program per (batch*head, q-tile): the q tile lives in
+VMEM, K/V for the whole (local) sequence stream through VMEM, and the
+softmax is computed online (running max / denominator, never a full
+[T, T] score matrix in HBM).  MXU does the two matmuls per K/V tile; the
+online-softmax rescale rides the VPU.
+
+Scope: per-shard sequence lengths where K/V fit VMEM (T*D*4B each —
+thousands of positions at D=64..128), which is exactly the per-device
+block regime of :func:`chainermn_tpu.parallel.sequence.ring_attention` /
+``ulysses_attention`` (pass ``attn_fn=flash_attention``).
+
+Differentiation: forward runs the fused kernel; backward is the standard
+blockwise flash gradient (recompute softmax stats, then per-tile
+dq/dk/dv accumulation) — the [T, T] matrix is materialized in NEITHER
+direction, so training memory stays O(T * block) too.  Off-TPU the
+kernel runs in Pallas interpret mode so the CPU test mesh exercises the
+same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable installs; interpret mode needs it not
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_BLOCK_Q = 256
+_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k):
+    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, BQ, D]
+    # Keep matmul inputs in their storage dtype (bf16 rides the MXU at
+    # full rate; f32 would quarter it) and accumulate in f32.
+    q = q_ref[0]                                         # [BQ, D]
+    t = k_ref.shape[1]
+    bq = q.shape[0]
+    q_off = pl.program_id(1) * bq
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :]
+        # scale after the matmul — same op order as the unfused reference,
+        # so results match it to tight tolerance
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    n_k = t // block_k
+    if causal:
+        # K/V tiles strictly after this q tile's last row are fully masked;
+        # skip them (upper bound depends on the q tile -> dynamic).
+        n_k = jnp.minimum(n_k, (q_off + bq + block_k - 1) // block_k)
+    d = q.shape[1]
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    if t % bq or t % bk:
+        raise ValueError(
+            f"flash_attention needs seq len ({t}) divisible by its tiles "
+            f"({bq}, {bk}); pad the sequence or pass smaller block sizes")
+    # [B, T, H, D] -> [B*H, T, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    kern = functools.partial(_kernel, sm_scale=scale, causal=causal,
+                             block_k=bk)
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    # Inside shard_map the output must carry the inputs' varying-axes
+    # metadata (vma) so the kernel composes with sequence parallelism.
+    try:
+        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype,
+                                         vma=jax.typeof(qf).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
+    out = pl.pallas_call(
+        kern,
+        grid=(b * h, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K):
+    """Fused softmax attention: [B, T, H, D] q/k/v -> [B, T, H, D].
+
+    Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
+    signature minus offsets); pass as ``attn_fn=`` to
+    ``ulysses_attention`` for a fused inner kernel.  ``block_q``/
+    ``block_k`` tune the tile sizes (sequence length must be a multiple
+    of each, or fit a single tile).
+    """
+    interpret = jax.default_backend() != "tpu"
+    return _forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, res, g):
+    """Blockwise flash backward — the [T, T] score matrix is never
+    materialized in the backward either.
+
+    Standard flash-attention gradient algebra, tile by tile (j over K/V
+    tiles): recompute ``s_ij``/``p_ij`` from the saved q/k and the
+    softmax stats, then
+
+        dv_j  = p_ij^T @ dO_i
+        dp_ij = dO_i @ v_j^T
+        ds_ij = p_ij * (dp_ij - D_i) * scale,  D_i = rowsum(dO_i * O_i)
+        dq_i += ds_ij @ k_j ;  dk_j = ds_ij^T @ q_i
+
+    The softmax stats (m, l) are recomputed with one extra blockwise pass
+    (primal math only — no autodiff residuals), keeping peak memory at
+    O(T * block_k) per (batch, head) in both passes.
+    """
+    q, k, v, out = res
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    bk = min(block_k, t)
+    if t % bk:
+        raise ValueError(f"sequence length {t} not divisible by block_k {bk}")
+    n = t // bk
+    # [B, T, H, D] -> [B, H, T, D] f32 working layout
+    tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
+    qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
+    q_pos = jnp.arange(t)
+
+    def stats_fold(carry, j):
+        m, l = carry
+        kb = jax.lax.dynamic_slice_in_dim(kT, j * bk, bk, axis=2)
+        s = jnp.einsum("bhtd,bhsd->bhts", qT, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        l_new = l * jnp.exp(m - m_new) + jnp.exp(
+            s - m_new[..., None]).sum(-1)
+        return (m_new, l_new), None
+
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    (m, l), _ = jax.lax.scan(stats_fold, (m0, l0), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    D = (gT * oT).sum(-1)                                  # [B, H, T]
+
+    def grad_fold(dq, j):
+        kb = jax.lax.dynamic_slice_in_dim(kT, j * bk, bk, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vT, j * bk, bk, axis=2)
+        s = jnp.einsum("bhtd,bhsd->bhts", qT, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l[..., None]       # [B, H, T, bk]
+        dv_j = jnp.einsum("bhts,bhtd->bhsd", p, gT)
+        dp = jnp.einsum("bhtd,bhsd->bhts", gT, vb)
+        ds = p * (dp - D[..., None]) * scale
+        dq = dq + jnp.einsum("bhts,bhsd->bhtd", ds, kb)
+        dk_j = jnp.einsum("bhts,bhtd->bhsd", ds, qT)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qT)
+    dq, (dk_tiles, dv_tiles) = jax.lax.scan(grad_fold, dq0, jnp.arange(n))
+    # [n, B, H, bk, D] -> [B, H, T, D]
+    merge = lambda tiles: tiles.transpose(1, 2, 0, 3, 4).reshape(b, h, t, d)
+    back = lambda x, ref: x.transpose(0, 2, 1, 3).astype(ref.dtype)
+    return (back(dq, q), back(merge(dk_tiles), k), back(merge(dv_tiles), v))
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+__all__ = ["flash_attention"]

@@ -51,7 +51,7 @@ def init_distributed(
     # IMPORTANT: nothing in this function may query the backend
     # (jax.devices()/default_backend()) before initialize() — that would
     # initialize XLA and make jax.distributed.initialize() fail.
-    if coordinator is None and num_processes is None:
+    if coordinator is None and num_processes is None and process_id is None:
         # TPU pod path: `jax.distributed.initialize()` with no args reads
         # slice metadata.  Attempt it only when the configured platform
         # looks like TPU; off-TPU stay single-controller.
@@ -60,8 +60,21 @@ def init_distributed(
         if "tpu" in platforms:
             try:
                 jax.distributed.initialize()
-            except Exception:
-                pass  # single host / already initialized
+            except RuntimeError as e:
+                # "already initialized" is fine; anything else must NOT be
+                # swallowed — each host silently proceeding as its own
+                # single-controller world would train divergent models.
+                if "already" not in str(e).lower():
+                    raise
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"jax.distributed.initialize() from TPU metadata "
+                    f"failed ({e!r}); continuing single-controller. If "
+                    f"this host is part of a multi-host slice, fix the "
+                    f"bootstrap — training would silently diverge.",
+                    RuntimeWarning)
         return
 
     if coordinator is None or num_processes is None or process_id is None:
