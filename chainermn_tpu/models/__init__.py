@@ -12,9 +12,11 @@ from chainermn_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from chainermn_tpu.models.transformer import TransformerLM
 from chainermn_tpu.models.vgg import VGG, VGG16
 
 __all__ = [
+    "TransformerLM",
     "MLP",
     "AlexNet",
     "NIN",

@@ -1,0 +1,115 @@
+"""Decoder-only Transformer LM — the long-context flagship model.
+
+**Beyond-reference extension** (the reference's model zoo is 2017 ImageNet
+convnets + an LSTM seq2seq — SURVEY.md §2.6; transformers postdate it).
+This model exists to make the sequence-parallel machinery concrete: its
+attention is pluggable between
+
+* ``attention_impl="flash"`` — the fused Pallas kernel
+  (:func:`chainermn_tpu.ops.flash_attention`), single-shard;
+* ``attention_impl="ring"`` — ring attention over a mesh axis
+  (:func:`chainermn_tpu.parallel.sequence.ring_attention`) for sequences
+  sharded across chips;
+* ``attention_impl="ulysses"`` — all-to-all head/sequence exchange;
+* ``attention_impl="xla"`` — the unfused reference math.
+
+Pre-LN blocks, learned positional embeddings, GELU MLP; bf16-capable with
+f32 parameters (same conventions as the image zoo).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _attend(impl: str, axis_name, q, k, v, causal: bool):
+    if impl == "flash":
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal)
+    if impl == "ring":
+        from chainermn_tpu.parallel.sequence import ring_attention
+
+        return ring_attention(q, k, v, axis_name, causal=causal)
+    if impl == "ulysses":
+        from chainermn_tpu.parallel.sequence import ulysses_attention
+
+        return ulysses_attention(q, k, v, axis_name, causal=causal)
+    if impl == "xla":
+        from chainermn_tpu.parallel.sequence import attention
+
+        return attention(q, k, v, causal=causal)
+    raise ValueError(
+        f"attention_impl must be flash|ring|ulysses|xla, got {impl!r}")
+
+
+class Block(nn.Module):
+    n_heads: int
+    attention_impl: str = "xla"
+    axis_name: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.n_heads
+        dense = lambda f, name: nn.Dense(
+            f, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        ln = lambda name: nn.LayerNorm(dtype=self.dtype,
+                                       param_dtype=jnp.float32, name=name)
+
+        h = ln("ln_attn")(x)
+        qkv = dense(3 * d_model, "qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = h.shape[:-1] + (self.n_heads, head_dim)
+        out = _attend(self.attention_impl, self.axis_name,
+                      q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                      causal=True)
+        x = x + dense(d_model, "proj")(out.reshape(h.shape))
+
+        h = ln("ln_mlp")(x)
+        h = nn.gelu(dense(4 * d_model, "up")(h))
+        return x + dense(d_model, "down")(h)
+
+
+class TransformerLM(nn.Module):
+    """``apply(params, tokens[B, T]) -> logits[B, T, vocab]`` (causal).
+
+    With ``attention_impl="ring"``/``"ulysses"``, apply inside an SPMD
+    region (``shard_map``) with ``tokens`` sharded [B, T/P] on
+    ``axis_name`` — positions are global via ``pos_offset``.
+    """
+
+    vocab: int
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    max_len: int = 8192
+    attention_impl: str = "xla"
+    axis_name: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide into n_heads")
+        x = nn.Embed(self.vocab, self.d_model, param_dtype=jnp.float32,
+                     dtype=self.dtype, name="tok_emb")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,
+                       dtype=self.dtype, name="pos_emb")(
+            pos_offset + jnp.arange(tokens.shape[-1]))
+        x = x + pos
+        for i in range(self.n_layers):
+            x = Block(self.n_heads, self.attention_impl, self.axis_name,
+                      self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        logits = nn.Dense(self.vocab, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+__all__ = ["Block", "TransformerLM"]

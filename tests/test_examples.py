@@ -61,6 +61,15 @@ def test_seq2seq_model_parallel():
 
 
 @pytest.mark.slow
+def test_long_context_ring_attention():
+    """Sequence-sharded LM training over ring attention (extension)."""
+    out = _run("long_context/train_lm.py",
+               "--attention", "ring", "--seq-len", "256", "--steps", "8",
+               "--batchsize", "2", "--d-model", "64", "--layers", "1")
+    assert "done in" in out
+
+
+@pytest.mark.slow
 def test_parallel_convolution():
     """Channel-split conv demo (the reference's parallel_convolution)."""
     out = _run("parallel_convolution/train_parallel_conv.py",

@@ -1,0 +1,99 @@
+"""TransformerLM: attention impls agree; sequence-parallel matches
+single-device; the long-context model trains.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chainermn_tpu.models import TransformerLM
+
+VOCAB, D, LAYERS, HEADS = 64, 64, 2, 8
+B, T = 2, 256
+
+
+def _tokens(seed=0, t=T):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray((rng.rand(B, t) * VOCAB).astype(np.int32))
+
+
+def _model(impl, axis=None):
+    return TransformerLM(vocab=VOCAB, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=1024,
+                         attention_impl=impl, axis_name=axis)
+
+
+def test_flash_impl_matches_xla():
+    toks = _tokens()
+    params = _model("xla").init(jax.random.key(0), toks)
+    out_xla = _model("xla").apply(params, toks)
+    out_flash = _model("flash").apply(params, toks)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_xla),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_matches_single_device(devices, impl):
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    toks = _tokens(1)
+    ref_model = _model("xla")
+    params = ref_model.init(jax.random.key(0), toks)
+    want = ref_model.apply(params, toks)
+
+    sp_model = _model(impl, axis="sp")
+    t_local = T // 8
+
+    def body(p, tk):
+        me = jax.lax.axis_index("sp")
+        return sp_model.apply(p, tk, pos_offset=me * t_local)
+
+    got = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp")))(params, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_long_context_trains(devices):
+    """Copy-task training through ring attention on an 8-way sequence mesh:
+    one backward spans the ring; loss decreases."""
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    t = 128
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray((rng.rand(B, t) * VOCAB).astype(np.int32))
+    model = _model("ring", axis="sp")
+    t_local = t // 8
+    params = _model("xla").init(jax.random.key(0), toks)
+
+    def loss_fn(p, tk):
+        def body(pp, tkk):
+            me = jax.lax.axis_index("sp")
+            logits = model.apply(pp, tkk, pos_offset=me * t_local)
+            # next-token prediction within each shard (boundary tokens
+            # excluded — enough signal for the smoke test)
+            lo = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tkk[:, 1:]).mean()
+            return jax.lax.pmean(lo, "sp")
+
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P(None, "sp")),
+            out_specs=P())(p, tk)
+
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    step = jax.jit(lambda p, s, tk: _update(p, s, tk, loss_fn, opt))
+    losses = []
+    for i in range(10):
+        params, state, l = step(params, state, toks)
+        jax.block_until_ready(l)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def _update(p, s, tk, loss_fn, opt):
+    l, g = jax.value_and_grad(loss_fn)(p, tk)
+    updates, s = opt.update(g, s, p)
+    return optax.apply_updates(p, updates), s, l
