@@ -39,7 +39,17 @@ def main():
         attention, ring_attention, ulysses_attention)
     from chainermn_tpu.utils.cpu_mesh import ensure_device_count
 
-    devices = ensure_device_count(2)
+    # Keep a single real accelerator chip (degenerate 1-way "ring", but the
+    # fused-vs-unfused single-device comparison is the interesting row
+    # there); only fall back to the virtual CPU mesh when the current
+    # backend is CPU with too few devices.
+    try:
+        devices = jax.devices()
+        backend = jax.default_backend()
+    except Exception:       # pre-initialized backend with no chip attached
+        devices, backend = [], "cpu"
+    if len(devices) < 2 and backend == "cpu":
+        devices = ensure_device_count(8)
     n = len(devices)
     mesh = Mesh(np.array(devices), ("sp",))
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
@@ -50,6 +60,8 @@ def main():
             fn, mesh=mesh,
             in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp")))
 
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
     impls = {
         "ring": spmd(lambda q, k, v: ring_attention(
             q, k, v, axis_name="sp", causal=True)),
@@ -57,6 +69,8 @@ def main():
             q, k, v, axis_name="sp", causal=True)),
         "single_device": jax.jit(
             lambda q, k, v: attention(q, k, v, causal=True)),
+        "single_device_flash": jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True)),
     }
 
     results = []

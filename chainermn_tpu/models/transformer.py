@@ -95,7 +95,9 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
         if self.d_model % self.n_heads:
-            raise ValueError("d_model must divide into n_heads")
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must divide d_model "
+                f"({self.d_model})")
         x = nn.Embed(self.vocab, self.d_model, param_dtype=jnp.float32,
                      dtype=self.dtype, name="tok_emb")(tokens)
         pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,

@@ -82,9 +82,22 @@ def main():
             def body(pp, tkk):
                 me = jax.lax.axis_index("sp")
                 logits = model.apply(pp, tkk, pos_offset=me * t_local)
-                lo = optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], tkk[:, 1:]).mean()
-                return jax.lax.pmean(lo, "sp")
+                # global next-token objective: each shard also predicts the
+                # FIRST token of the next shard (fetched with one ppermute),
+                # so the loss matches the single-device xla/flash objective
+                # exactly (every position supervised except the global last)
+                nxt = jax.lax.ppermute(
+                    tkk[:, :1], "sp",
+                    perm=[(i, (i - 1) % n_sp) for i in range(n_sp)])
+                targets = jnp.concatenate([tkk[:, 1:], nxt], axis=1)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets)
+                mask = jnp.ones_like(ce)
+                mask = mask.at[:, -1].set(
+                    jnp.where(me == n_sp - 1, 0.0, 1.0))
+                total = jax.lax.psum((ce * mask).sum(), "sp")
+                count = jax.lax.psum(mask.sum(), "sp")
+                return total / count
 
             return jax.shard_map(body, mesh=mesh,
                                  in_specs=(P(), P(None, "sp")),

@@ -1,0 +1,113 @@
+"""Multi-node iterators over a real 2-process control plane.
+
+Reference strategy (SURVEY.md §4): the master (rank 0) iterates the real
+dataset and broadcasts every batch; slaves are receive-only proxies —
+asserted here across two actual processes, plus single-process behavior
+of the synchronized iterator.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.iterators import SerialIterator, create_synchronized_iterator
+
+_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+from chainermn_tpu.runtime.control_plane import get_control_plane
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.iterators.multi_node_iterator import (
+    create_multi_node_iterator)
+
+
+class _CommFacade:
+    def __init__(self, cp):
+        self._cp = cp
+        self.rank = cp.rank
+        self.size = cp.size
+
+    def bcast_obj(self, obj, root=0):
+        return self._cp.bcast_obj(obj, root=root)
+
+
+cp = get_control_plane()
+comm = _CommFacade(cp)
+data = list(range(20))
+it = SerialIterator(data, batch_size=4, repeat=False, shuffle=False) \
+    if comm.rank == 0 else None
+mit = create_multi_node_iterator(it, comm)
+batches = []
+for batch in mit:
+    batches.append([int(x) for x in batch])
+print("RESULT " + json.dumps({"rank": comm.rank, "batches": batches,
+                              "epoch": mit.epoch}))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_master_feeds_slave_two_processes():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({
+            "CHAINERMN_TPU_COORDINATOR": coord,
+            "CHAINERMN_TPU_NUM_PROCESSES": "2",
+            "CHAINERMN_TPU_PROCESS_ID": str(r),
+            "CHAINERMN_TPU_REPO": repo,
+            "PYTHONPATH": repo,
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    for r, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{stderr}\n{stdout}"
+        line = [l for l in stdout.splitlines() if l.startswith("RESULT ")]
+        results[r] = json.loads(line[0][len("RESULT "):])
+
+    want = [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11],
+            [12, 13, 14, 15], [16, 17, 18, 19]]
+    # the slave received exactly the master's batches, then StopIteration
+    assert results[0]["batches"] == want
+    assert results[1]["batches"] == want
+
+
+def test_synchronized_iterator_same_order():
+    comm = chainermn_tpu.create_communicator("naive")
+    data = list(range(32))
+    it_a = SerialIterator(data, batch_size=8, shuffle=True, seed=1)
+    it_b = SerialIterator(data, batch_size=8, shuffle=True, seed=2)
+    # one call per simulated host: pin the master's seed draw so the two
+    # calls stand in for two hosts receiving the same broadcast
+    np.random.seed(42)
+    it_a = create_synchronized_iterator(it_a, comm)
+    np.random.seed(42)
+    it_b = create_synchronized_iterator(it_b, comm)
+    # single host: both draw the SAME broadcast seed => identical order
+    a = [list(it_a.next()) for _ in range(4)]
+    b = [list(it_b.next()) for _ in range(4)]
+    assert a == b
+
+
+def test_synchronized_iterator_rejects_unsyncable():
+    comm = chainermn_tpu.create_communicator("naive")
+    with pytest.raises(TypeError, match="_rng"):
+        create_synchronized_iterator(iter([1, 2, 3]), comm)
