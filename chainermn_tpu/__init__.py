@@ -44,6 +44,12 @@ _EXPORTS = {
     "make_pipeline_fn": "chainermn_tpu.parallel.pipeline",
     # fused Pallas kernels
     "flash_attention": "chainermn_tpu.ops.flash_attention",
+    # tensor / expert parallelism (beyond-reference extensions)
+    "ColumnParallelDense": "chainermn_tpu.parallel.tensor",
+    "RowParallelDense": "chainermn_tpu.parallel.tensor",
+    "TensorParallelMLP": "chainermn_tpu.parallel.tensor",
+    "ExpertParallelMLP": "chainermn_tpu.parallel.expert",
+    "moe_apply": "chainermn_tpu.parallel.expert",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -24,8 +24,22 @@ from chainermn_tpu.parallel.pipeline import (
     make_pipeline_fn,
     pipeline_apply,
 )
+from chainermn_tpu.parallel.tensor import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TensorParallelMLP,
+)
+from chainermn_tpu.parallel.expert import (
+    ExpertParallelMLP,
+    moe_apply,
+)
 
 __all__ = [
+    "ColumnParallelDense",
+    "ExpertParallelMLP",
+    "RowParallelDense",
+    "TensorParallelMLP",
+    "moe_apply",
     "DATA_AXES",
     "INTER_AXIS",
     "INTRA_AXIS",

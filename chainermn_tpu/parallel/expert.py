@@ -1,0 +1,110 @@
+"""Expert parallelism — mixture-of-experts with all-to-all token routing.
+
+**Beyond-reference extension** (SURVEY.md §2.4: the reference has no
+EP/MoE).  The standard recipe on a mesh axis ``ep``:
+
+1. every device routes its local tokens (top-1 softmax gate over E
+   experts, E == axis size — one expert per device);
+2. capacity-bucketed dispatch: each device builds one fixed-size buffer
+   per expert (capacity C tokens, truncation beyond — static shapes for
+   XLA) and ``all_to_all``-s them, so each device receives the tokens
+   bound for ITS expert from everyone;
+3. the local expert (an MLP) processes its buffer;
+4. the inverse ``all_to_all`` returns outputs, which are combined back
+   into token order, scaled by the gate probability (straight-through
+   for dropped tokens: they pass through unchanged).
+
+:func:`moe_apply` is the functional core; :class:`ExpertParallelMLP` is
+the flax wrapper holding the router + local expert parameters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.utils import axis_size as _axis_size
+
+
+def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
+              capacity: Optional[int] = None):
+    """Route local tokens [N, D] to per-device experts; return [N, D].
+
+    ``gate_logits``: [N, E] (E == axis size).  ``expert_fn(tokens[C*E, D])
+    -> [C*E, D]`` applies THIS device's expert to its received buffer.
+    ``capacity`` defaults to ``2 * N // E``; tokens over capacity fall
+    through the residual path (identity), the standard truncation rule.
+    """
+    e = _axis_size(axis_name)
+    n, d = x.shape
+    c = capacity if capacity is not None else max(1, 2 * n // e)
+
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = gates.argmax(-1)                     # [N]
+    gate_p = jnp.take_along_axis(gates, expert_idx[:, None], 1)[:, 0]
+
+    # position of each token within its expert's bucket (capacity slot)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)       # [N, E]
+    slot = (jnp.cumsum(onehot, axis=0) - 1)                       # [N, E]
+    slot = (slot * onehot).sum(-1)                                # [N]
+    keep = slot < c
+
+    # scatter tokens into [E, C, D] send buffers (dropped tokens nowhere)
+    send = jnp.zeros((e, c, d), x.dtype)
+    send = send.at[expert_idx, jnp.where(keep, slot, 0)].add(
+        jnp.where(keep[:, None], x, 0.0))
+    # [E, C, D] -> all_to_all -> [E, C, D]: row i now holds MY expert's
+    # tokens from device i
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    out = expert_fn(recv.reshape(e * c, d)).reshape(e, c, d)
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                             # [E, C, D]
+
+    # gather back to token order; dropped tokens pass through (residual)
+    routed = back[expert_idx, jnp.where(keep, slot, 0)]
+    y = jnp.where(keep[:, None], routed * gate_p[:, None].astype(x.dtype),
+                  x)
+    return y
+
+
+class ExpertParallelMLP(nn.Module):
+    """Top-1 MoE layer: router + one local expert MLP per device.
+
+    Apply inside ``shard_map`` with tokens sharded [B*T/E, D] on
+    ``axis_name``.  Expert parameters are device-local (each device's
+    ``expert`` params are its own expert — vary init per device or train
+    from identical init, they diverge through routing).
+    """
+
+    hidden: int
+    axis_name: Any = "ep"
+    capacity: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        e = _axis_size(self.axis_name)
+        router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")
+        d = x.shape[-1]
+        up = nn.Dense(self.hidden, dtype=self.dtype,
+                      param_dtype=jnp.float32, name="up")
+        down = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="down")
+
+        def expert_fn(tokens):
+            return down(nn.gelu(up(tokens)))
+
+        shape = x.shape
+        flat = x.reshape(-1, d)
+        y = moe_apply(expert_fn, router(flat), flat, self.axis_name,
+                      capacity=self.capacity)
+        return y.reshape(shape)
+
+
+__all__ = ["ExpertParallelMLP", "moe_apply"]
