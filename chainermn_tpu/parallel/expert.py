@@ -41,6 +41,11 @@ def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
     """
     e = _axis_size(axis_name)
     n, d = x.shape
+    if gate_logits.shape[-1] != e:
+        raise ValueError(
+            f"gate_logits has {gate_logits.shape[-1]} experts but the "
+            f"'{axis_name}' axis has {e} devices (one expert per device); "
+            f"a mismatch would silently misroute via clamped indices")
     c = capacity if capacity is not None else max(1, 2 * n // e)
 
     gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)

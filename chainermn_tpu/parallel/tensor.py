@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from chainermn_tpu.utils import axis_size as _axis_size
+
 
 class ColumnParallelDense(nn.Module):
     """Output-feature-sharded Dense: full input -> local feature slice.
@@ -56,9 +58,7 @@ class ColumnParallelDense(nn.Module):
             # value-identical, but typed INVARIANT over the axis (the vma
             # system cannot infer invariance for all_gather outputs), so
             # the result composes with replicated out_specs.
-            size = (lax.axis_size(self.axis_name)
-                    if hasattr(lax, "axis_size")
-                    else lax.psum(1, self.axis_name))
+            size = _axis_size(self.axis_name)
             idx = lax.axis_index(self.axis_name)
             full = jnp.zeros(y.shape[:-1] + (size * self.features,),
                              y.dtype)
@@ -108,8 +108,7 @@ class TensorParallelMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        size = lax.psum(1, self.axis_name) if not hasattr(
-            lax, "axis_size") else lax.axis_size(self.axis_name)
+        size = _axis_size(self.axis_name)
         if self.hidden % size:
             raise ValueError(
                 f"hidden ({self.hidden}) must divide by the tp axis "

@@ -98,6 +98,61 @@ def test_tp_gradients_match_dense(mesh):
                                    err_msg=f"grad wrt {name}")
 
 
+def test_tensor_parallel_mlp_module(mesh):
+    """The Module wrapper (init under shard_map, hidden%size check)."""
+    from chainermn_tpu.parallel.tensor import TensorParallelMLP
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(B, D), jnp.float32)
+    mlp = TensorParallelMLP(hidden=H, axis_name="tp")
+
+    def body(xx):
+        p = mlp.init(jax.random.key(0), xx)
+        return mlp.apply(p, xx)
+
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                                out_specs=P()))(x)
+    assert out.shape == x.shape and float(jnp.abs(out).sum()) > 0
+
+    bad = TensorParallelMLP(hidden=H + 1, axis_name="tp")
+    with pytest.raises(ValueError, match="divide"):
+        jax.jit(jax.shard_map(
+            lambda xx: bad.init(jax.random.key(0), xx),
+            mesh=mesh, in_specs=P(), out_specs=P()))(x)
+
+
+def test_expert_parallel_mlp_module(mesh):
+    """The MoE Module wrapper end to end (router + local expert)."""
+    from chainermn_tpu.parallel.expert import ExpertParallelMLP
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(E * 8, D), jnp.float32)
+    moe = ExpertParallelMLP(hidden=32, axis_name="ep")
+
+    def body(xx):
+        p = moe.init(jax.random.key(1), xx)
+        return moe.apply(p, xx)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=Mesh(mesh.devices, ("ep",)),
+        in_specs=P("ep"), out_specs=P("ep")))(x)
+    assert out.shape == x.shape
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_moe_rejects_expert_count_mismatch(mesh):
+    from chainermn_tpu.parallel.expert import moe_apply
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(E * 4, D), jnp.float32)
+    bad_logits = jnp.asarray(rng.randn(E * 4, E + 2), jnp.float32)
+    with pytest.raises(ValueError, match="experts"):
+        jax.jit(jax.shard_map(
+            lambda xx, ll: moe_apply(lambda t: t, ll, xx, "ep"),
+            mesh=Mesh(mesh.devices, ("ep",)),
+            in_specs=(P("ep"), P("ep")), out_specs=P("ep")))(x, bad_logits)
+
+
 class TestExpertParallel:
     N = 16  # tokens per device
 
