@@ -26,6 +26,21 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 125.0  # P100, arXiv:1711.04325 (BASELINE.md)
 
+# ResNet-50 @ 224x224: ~4.1 GFLOP forward per image; a full train step is
+# ~3x forward (fwd + 2x-cost bwd) ~= 12.3 GFLOP/image (standard accounting,
+# e.g. the MLPerf resnet reference).  Used only for the MFU report.
+TRAIN_GFLOP_PER_IMAGE = 12.3
+PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0,   # bf16 peak
+               "tpu v4": 275.0, "tpu v6 lite": 918.0, "tpu v6e": 918.0}
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_TFLOPS.items():
+        if k in kind:
+            return v
+    return 197.0  # assume v5e-class when the kind string is unrecognized
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -127,6 +142,13 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
     }
+    if on_tpu:
+        peak = _peak_tflops(jax.devices()[0])
+        mfu = per_chip * TRAIN_GFLOP_PER_IMAGE / 1e3 / peak
+        out["mfu"] = round(mfu, 4)
+        out["step_ms"] = round(dt / steps * 1e3, 2)
+        log(f"bench: MFU {mfu:.1%} (peak {peak} TFLOP/s bf16, "
+            f"{TRAIN_GFLOP_PER_IMAGE} GFLOP/img train)")
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Decompose the ResNet-50 train step time on one chip.
+
+Perf harness for the round-2 BN-statistics investigation (NEXT.md §1,
+VERDICT round-1 "next #1").  Times variants of the b=256 ResNet-50 step
+that surgically remove one cost at a time, so each feature's price is a
+measured subtraction, not a guess from trace categories:
+
+  full        — the bench.py step (fwd+bwd+allreduce+update, bf16)
+  nostats     — BatchNorm normalizes with CONSTANT mean/var (stat
+                reductions + their backward vanish; everything else,
+                including the normalize/scale elementwise math, stays)
+  nonorm      — BatchNorm replaced by identity (all BN work vanishes)
+  fwdonly     — forward pass only (no grad)
+  fwdbwd      — fwd+bwd only (no allreduce/update)
+
+Run on the real chip:  python benchmarks/bench_resnet_probe.py
+Each variant reports ms/step and img/s; deltas vs `full` are printed.
+
+NOTE: nostats/nonorm change the numerics (loss is garbage) — they exist
+only to price the memory traffic; they are never used for training.
+"""
+
+import argparse
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def time_step(step, args, steps, warmup):
+    import jax
+
+    for _ in range(warmup):
+        out = step(*args)
+    loss = out[-1]
+    jax.block_until_ready(loss)
+    float(np.asarray(loss))  # fence: value read (see SKILL.md timing gotcha)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(*args)
+    loss = out[-1]
+    jax.block_until_ready(loss)
+    float(np.asarray(loss))
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--variants", default="full,nostats,nonorm,fwdonly,fwdbwd")
+    args = p.parse_args()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet50
+    from chainermn_tpu.optimizers import (
+        init_model_state, init_opt_state, make_train_step)
+    from chainermn_tpu.training import put_global_batch
+
+    class ConstStatBN(nn.Module):
+        """BatchNorm body with mean/var pinned to constants.
+
+        Same gamma/beta params, same elementwise normalize math and dtype
+        flow as nn.BatchNorm — minus the batch statistics (and their
+        backward reductions).  Prices the stat computation alone.
+        """
+        use_running_average: bool = False
+        momentum: float = 0.9
+        epsilon: float = 1e-5
+        dtype: object = None
+        param_dtype: object = jnp.float32
+        scale_init: object = nn.initializers.ones_init()
+
+        @nn.compact
+        def __call__(self, x):
+            feat = x.shape[-1]
+            scale = self.param("scale", self.scale_init, (feat,),
+                               self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros_init(), (feat,),
+                              self.param_dtype)
+            # constant "stats": mean 0, var 1 (inv-sqrt still applied)
+            y = x * (scale * (1.0 / np.sqrt(1.0 + self.epsilon))).astype(
+                x.dtype) + bias.astype(x.dtype)
+            return y if self.dtype is None else y.astype(self.dtype)
+
+    class IdentityNorm(nn.Module):
+        use_running_average: bool = False
+        momentum: float = 0.9
+        epsilon: float = 1e-5
+        dtype: object = None
+        param_dtype: object = jnp.float32
+        scale_init: object = nn.initializers.ones_init()
+
+        @nn.compact
+        def __call__(self, x):
+            return x
+
+    n_classes = 1000
+    image = 224
+    comm = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(args.batch, image, image, 3).astype(np.float32)
+    y = (rng.rand(args.batch) * n_classes).astype(np.int32)
+    batch = put_global_batch(comm, (x, y))
+
+    results = {}
+    for variant in args.variants.split(","):
+        norm_cls = {"nostats": ConstStatBN, "nonorm": IdentityNorm}.get(
+            variant)
+        model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16)
+        if norm_cls is not None:
+            model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16,
+                             norm_cls=norm_cls)
+        variables = model.init(
+            jax.random.key(0), jnp.zeros((1, image, image, 3), jnp.float32))
+        params = variables["params"]
+        has_stats = "batch_stats" in variables
+        stats = variables.get("batch_stats", {})
+
+        def loss_fn(p, state, b, model=model, has_stats=has_stats):
+            xb, yb = b
+            if has_stats:
+                logits, mut = model.apply(
+                    {"params": p, "batch_stats": state}, xb, train=True,
+                    mutable=["batch_stats"])
+                new_state = mut["batch_stats"]
+            else:
+                logits = model.apply({"params": p}, xb, train=True)
+                new_state = state
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+            return loss, new_state
+
+        if variant == "fwdonly":
+            fn = jax.jit(lambda p, s, b: loss_fn(p, s, b)[0])
+            step_args = (params, stats, batch)
+            step = lambda p, s, b: (fn(p, s, b),)
+        elif variant == "fwdbwd":
+            grad_fn = jax.jit(jax.grad(lambda p, s, b: loss_fn(p, s, b)[0]))
+
+            def step(p, s, b):
+                g = grad_fn(p, s, b)
+                return (jax.tree.leaves(g)[0].sum(),)
+            step_args = (params, stats, batch)
+        else:
+            optimizer = chainermn_tpu.create_multi_node_optimizer(
+                optax.sgd(0.1, momentum=0.9), comm, double_buffering=True)
+            params = comm.bcast_data(params)
+            model_state = init_model_state(comm, stats)
+            opt_state = init_opt_state(comm, optimizer, params)
+            train = make_train_step(comm, loss_fn, optimizer,
+                                    with_model_state=True)
+            state_box = [params, model_state, opt_state]
+
+            def step(p_unused, s_unused, b):
+                ps, ms, os_, loss = train(state_box[0], state_box[1],
+                                          state_box[2], b)
+                state_box[0], state_box[1], state_box[2] = ps, ms, os_
+                return (loss,)
+            step_args = (None, None, batch)
+
+        dt = time_step(step, step_args, args.steps, warmup=4)
+        img_s = args.batch / dt
+        results[variant] = dt
+        log(f"{variant:8s}  {dt*1e3:7.2f} ms/step   {img_s:8.1f} img/s")
+
+    if "full" in results:
+        base = results["full"]
+        for v, dt in results.items():
+            if v != "full":
+                log(f"delta full-{v:8s} = {1e3*(base-dt):7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

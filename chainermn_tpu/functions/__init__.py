@@ -3,6 +3,8 @@ from chainermn_tpu.functions.point_to_point_communication import (
     recv,
     pseudo_connect,
     spmd_send_recv,
+    cross_send,
+    cross_recv,
 )
 from chainermn_tpu.functions.collective_communication import (
     allgather,
@@ -16,6 +18,8 @@ from chainermn_tpu.functions.collective_communication import (
 __all__ = [
     "send",
     "recv",
+    "cross_send",
+    "cross_recv",
     "pseudo_connect",
     "spmd_send_recv",
     "allgather",

@@ -145,3 +145,174 @@ def spmd_send_recv(x, communicator, pairs: List[Tuple[int, int]]):
     ``pairs`` receive zeros — the collective-permute semantics native to the
     ICI torus.  Differentiable (transpose = reversed permutation)."""
     return communicator.ppermute(x, pairs)
+
+
+# ---------------------------------------------------------------------------
+# Cross-controller p2p: the reference's Send/Recv between *processes*.
+#
+# Reference behavior being rebuilt (path unverified, SURVEY.md provenance):
+# 〔chainermn/functions/point_to_point_communication.py〕 ``Send.forward ->
+# comm.send(array)`` / ``Send.backward -> comm.recv(grad)`` between MPI
+# processes on different nodes — the path that made seq2seq span machines
+# 〔examples/seq2seq/seq2seq.py〕.
+#
+# TPU-native shape: the array payload rides the DCN control-plane transport
+# (host staging, exactly the reference's MPI object path); the backward is a
+# ``jax.custom_vjp`` whose reverse rule performs the opposite transfer.  The
+# host side effects are ``jax.experimental.io_callback(ordered=True)`` so the
+# same code works eagerly, under ``jax.vjp``/``value_and_grad`` (forward runs
+# ONCE), and under ``jit``.
+#
+# Contract (documented; the reference had the same shape): each
+# ``cross_send`` must pair with exactly one ``cross_recv`` per executed
+# forward, and the forward must run exactly once per step — compute grads
+# with ``jax.value_and_grad``/``jax.vjp`` around the whole local composition
+# rather than calling the model separately from the grad.
+# ---------------------------------------------------------------------------
+
+_GRAD_TAG_OFFSET = 1 << 20   # reverse-transfer (cotangent) tag namespace
+_META_TAG_OFFSET = 1 << 21   # trace-time shape/treedef handshake namespace
+
+
+def _is_inexact(leaf) -> bool:
+    return jnp.issubdtype(jnp.result_type(leaf), jnp.inexact)
+
+
+def _meta_cache(communicator) -> dict:
+    """Per-communicator handshake cache.  A (peer, tag) channel's payload
+    shape is exchanged once — after that, both ends reuse it, removing a
+    blocking DCN round-trip per boundary per step.  Consequence: a given
+    tag's payload structure/shape is FIXED for the communicator's lifetime;
+    use a fresh tag for a different shape (same contract as the reference's
+    persistent MPI datatype per channel)."""
+    cache = getattr(communicator, "_p2p_meta_cache", None)
+    if cache is None:
+        cache = communicator._p2p_meta_cache = {}
+    return cache
+
+
+def cross_send(x, communicator, dest_process: int, tag: int = 0):
+    """Ship pytree ``x`` to controller process ``dest_process``; returns the
+    delegate variable.  Backward receives the cotangent of ``x`` back from
+    ``dest_process`` (the reference's ``Send.backward -> comm.recv(grad)``).
+    """
+    from jax.experimental import io_callback
+    import numpy as np
+    import pickle
+
+    leaves, treedef = jax.tree.flatten(x)
+    metas = [(tuple(jnp.shape(l)), str(jnp.result_type(l))) for l in leaves]
+    # Shape/structure handshake (the reference's dtype/shape header):
+    # exchanged once per (dest, tag) channel, cached afterwards.
+    cache = _meta_cache(communicator)
+    key = ("send", dest_process, tag)
+    if key not in cache:
+        communicator.send_obj(("p2p-meta", pickle.dumps(treedef), metas),
+                              dest_process, tag=_META_TAG_OFFSET + tag)
+        cache[key] = (treedef, metas)
+    elif cache[key] != (treedef, metas):
+        raise ValueError(
+            f"cross_send tag {tag} to process {dest_process} was first used "
+            f"with a different payload structure/shape; a channel's shape is "
+            "fixed after the first exchange — use a distinct tag per shape")
+
+    grad_shapes = [jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                   for (s, d), l in zip(metas, leaves) if _is_inexact(l)]
+
+    def host_send(*np_leaves):
+        communicator.send_obj([np.asarray(a) for a in np_leaves],
+                              dest_process, tag=tag)
+
+    def host_recv_grads():
+        gs = communicator.recv_obj(dest_process, tag=_GRAD_TAG_OFFSET + tag)
+        return tuple(np.asarray(g) for g in gs)
+
+    @jax.custom_vjp
+    def snd(*lv):
+        io_callback(host_send, None, *lv, ordered=True)
+        return _delegate_of(lv)
+
+    def snd_fwd(*lv):
+        io_callback(host_send, None, *lv, ordered=True)
+        return _delegate_of(lv), None
+
+    def snd_bwd(_, g):
+        gs = list(io_callback(host_recv_grads, tuple(grad_shapes),
+                              ordered=True))
+        out = []
+        for leaf in leaves:
+            if _is_inexact(leaf):
+                out.append(gs.pop(0))
+            else:
+                out.append(jax.custom_derivatives.zero_from_primal(
+                    leaf, symbolic_zeros=False))
+        return tuple(out)
+
+    snd.defvjp(snd_fwd, snd_bwd)
+    return snd(*leaves)
+
+
+def cross_recv(communicator, source_process: int, tag: int = 0,
+               delegate_variable=None, device_put=None, anchor=None):
+    """Receive the pytree sent by ``cross_send`` on ``source_process``.
+    Backward ships the cotangent back (``Recv.backward -> comm.send(grad)``).
+
+    ``anchor`` MUST be (a pytree containing) at least one array being
+    differentiated in the surrounding ``jax.vjp``/``value_and_grad`` —
+    typically this stage's parameters.  Chainer walked every node of its
+    dynamic graph so ``Recv.backward`` always ran; JAX's backward pass only
+    visits ops on a path from the differentiated inputs to the loss, so the
+    reverse transfer must hang off such a path.  Without an anchor the recv
+    is forward-only (no cotangent is returned to the sender) — fine for
+    inference, wrong for training.
+
+    ``device_put`` optionally places the received arrays (e.g. batch-sharded
+    over this process's local devices)."""
+    from jax.experimental import io_callback
+    import numpy as np
+    import pickle
+
+    cache = _meta_cache(communicator)
+    key = ("recv", source_process, tag)
+    if key in cache:
+        treedef, metas = cache[key]
+    else:
+        kind, treedef_bytes, metas = communicator.recv_obj(
+            source_process, tag=_META_TAG_OFFSET + tag)
+        if kind != "p2p-meta":
+            raise RuntimeError(f"out-of-order p2p handshake: got {kind!r}")
+        treedef = pickle.loads(treedef_bytes)
+        cache[key] = (treedef, metas)
+    shapes = [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in metas]
+    inexact = [jnp.issubdtype(s.dtype, jnp.inexact) for s in shapes]
+
+    def host_recv():
+        vals = communicator.recv_obj(source_process, tag=tag)
+        return tuple(np.asarray(v) for v in vals)
+
+    def host_send_grads(*gs):
+        communicator.send_obj([np.asarray(g) for g in gs], source_process,
+                              tag=_GRAD_TAG_OFFSET + tag)
+
+    @jax.custom_vjp
+    def rcv(anchor_tok):
+        del anchor_tok
+        return io_callback(host_recv, tuple(shapes), ordered=True)
+
+    def rcv_fwd(anchor_tok):
+        return rcv(anchor_tok), None
+
+    def rcv_bwd(_, gs):
+        gfloat = [g for g, ix in zip(gs, inexact) if ix]
+        io_callback(host_send_grads, None, *gfloat, ordered=True)
+        return (jnp.zeros((0,), jnp.float32),)
+
+    rcv.defvjp(rcv_fwd, rcv_bwd)
+    leaves = list(rcv(_delegate_of(anchor) if anchor is not None
+                      else jnp.zeros((0,), jnp.float32)))
+    if device_put is not None:
+        leaves = [device_put(l) for l in leaves]
+    x = jax.tree.unflatten(treedef, leaves)
+    if delegate_variable is not None:
+        x = pseudo_connect(delegate_variable, x)
+    return x

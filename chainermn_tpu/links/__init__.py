@@ -1,6 +1,8 @@
-from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+from chainermn_tpu.links.multi_node_chain_list import (MultiNodeChainList,
+                                                        pseudo_loss)
 from chainermn_tpu.links.multi_node_batch_normalization import (
     MultiNodeBatchNormalization,
 )
 
-__all__ = ["MultiNodeBatchNormalization", "MultiNodeChainList"]
+__all__ = ["MultiNodeBatchNormalization", "MultiNodeChainList",
+           "pseudo_loss"]

@@ -25,6 +25,21 @@ TPU-native re-interpretation (single controller, MPMD over device groups):
 The execution is eager at stage granularity (matching the reference's
 define-by-run semantics); for homogeneous-stage high-throughput pipelining
 see ``chainermn_tpu.parallel.pipeline``.
+
+Multi-controller mode (the reference's actual deployment shape — one MPI
+process per node): when the communicator spans several controller
+processes (``comm.host_size > 1``), stage ``s`` executes on process
+``s % host_size`` using that process's local devices, and stage
+boundaries that cross processes become :func:`cross_send` /
+:func:`cross_recv` — differentiable DCN transfers whose backward ships
+the cotangent the opposite way, exactly the reference's
+``Send.backward -> comm.recv(grad)`` over MPI.  Every process runs the
+same registration/apply code (SPMD at the script level, like running
+under ``mpiexec``); ``apply`` returns the real outputs on the process
+owning the exit stage and a zero-size *delegate* elsewhere — pass it to
+:func:`pseudo_loss` so one ``jax.value_and_grad`` per process drives the
+globally-connected backward, the reference's ``pseudo_connect`` +
+``loss.backward()`` choreography.
 """
 
 from __future__ import annotations
@@ -32,6 +47,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -43,6 +59,25 @@ Ranks = Union[int, Sequence[int], None]
 
 
 _instance_counter = iter(range(1 << 30))
+# Cross-process chains need a SMALL stable namespace (tags are packed into
+# the transport's 20-bit payload-tag space); counted separately so ordinary
+# single-controller instances don't consume it.
+_cross_instance_counter = iter(range(1 << 30))
+_MAX_CROSS_INSTANCES = 32
+
+
+def pseudo_loss(out) -> "jax.Array":
+    """Scalar pseudo-loss for a delegate returned by ``apply`` on a process
+    that does not own the exit stage — the reference's "call backward() on
+    the delegate variable" idiom.  Value is 0.0 but it is data-dependent on
+    every cross-process send, so ``jax.value_and_grad`` reaches their
+    backward transfers."""
+    leaves = jax.tree.leaves(out)
+    acc = jnp.zeros((), jnp.float32)
+    for l in leaves:
+        if jnp.issubdtype(jnp.result_type(l), jnp.inexact):
+            acc = acc + jnp.sum(l).astype(jnp.float32)
+    return acc
 
 
 class MultiNodeChainList:
@@ -56,6 +91,42 @@ class MultiNodeChainList:
         # F.send/F.recv, which default to tag 0) may share one communicator;
         # each instance's channels must neither collide with nor clear theirs.
         self._tag = 1 + next(_instance_counter)
+        if self._n_procs > 1:
+            self._cross_base = next(_cross_instance_counter)
+            if self._cross_base >= _MAX_CROSS_INSTANCES:
+                raise RuntimeError(
+                    f"more than {_MAX_CROSS_INSTANCES} cross-process "
+                    "MultiNodeChainList instances in one process; the packed "
+                    "DCN tag namespace is exhausted")
+
+    # -- multi-controller placement -----------------------------------------
+    @property
+    def _n_procs(self) -> int:
+        return int(getattr(self._comm, "host_size", 1))
+
+    def stage_owner(self, s: int) -> int:
+        """Controller process that executes stage ``s`` (reference: the MPI
+        rank the link was assigned to; here registration order mod world)."""
+        return s % self._n_procs
+
+    def is_local_stage(self, s: int) -> bool:
+        return (self._n_procs == 1
+                or self.stage_owner(s) == self._comm.host_rank)
+
+    @property
+    def owns_output(self) -> bool:
+        """True when this process executes an exit stage (``rank_out=None``)
+        — i.e. ``apply`` returns real outputs here, a delegate elsewhere."""
+        return any(self.is_local_stage(s)
+                   for s, (_, _, rout) in enumerate(self._links)
+                   if rout is None)
+
+    def _cross_tag(self, src: int, dst: int, occ: int) -> int:
+        if src >= 32 or dst >= 32 or occ >= 32:
+            raise ValueError("cross-process chains support at most 32 "
+                             "stages and 32 sends per stage pair")
+        return ((self._cross_base % _MAX_CROSS_INSTANCES) << 15 \
+                | src << 10 | dst << 5 | occ)
 
     # -- registration --------------------------------------------------------
     def add_link(self, module, rank_in: Ranks = None, rank_out: Ranks = None):
@@ -70,8 +141,31 @@ class MultiNodeChainList:
         return len(self._links)
 
     # -- placement -----------------------------------------------------------
-    def _meshes(self) -> List[Mesh]:
+    def _meshes(self) -> List[Optional[Mesh]]:
         if self._stage_meshes is None:
+            if self._n_procs > 1:
+                # Multi-controller: a stage's devices are its owner
+                # process's LOCAL devices (remote stages get None — their
+                # placement is not this process's business, matching the
+                # reference where each MPI rank only ever names its own
+                # GPU).  Several local stages split the local devices.
+                local = [d for d in self._comm.mesh.devices.flat
+                         if d.process_index == jax.process_index()]
+                mine = [s for s in range(self.n_stages)
+                        if self.is_local_stage(s)]
+                meshes: List[Optional[Mesh]] = [None] * self.n_stages
+                if mine:
+                    if len(local) >= len(mine):
+                        groups = np.array_split(
+                            np.asarray(local, dtype=object), len(mine))
+                    else:
+                        groups = [np.asarray([local[i % len(local)]],
+                                             dtype=object)
+                                  for i in range(len(mine))]
+                    for s, g in zip(mine, groups):
+                        meshes[s] = Mesh(g, (STAGE_DP_AXIS,))
+                self._stage_meshes = meshes
+                return self._stage_meshes
             devs = list(self._comm.mesh.devices.flat)
             if len(devs) >= self.n_stages:
                 groups = np.array_split(np.asarray(devs, dtype=object),
@@ -86,14 +180,24 @@ class MultiNodeChainList:
                 Mesh(g, (STAGE_DP_AXIS,)) for g in groups]
         return self._stage_meshes
 
+    def _local_mesh(self, stage: int) -> Mesh:
+        mesh = self._meshes()[stage]
+        if mesh is None:
+            raise ValueError(
+                f"stage {stage} is owned by controller process "
+                f"{self.stage_owner(stage)}, not this process "
+                f"({self._comm.host_rank}); its placement is only known "
+                "on its owner")
+        return mesh
+
     def stage_devices(self, stage: int):
-        return list(self._meshes()[stage].devices.flat)
+        return list(self._local_mesh(stage).devices.flat)
 
     def _param_sharding(self, stage: int) -> NamedSharding:
-        return NamedSharding(self._meshes()[stage], P())
+        return NamedSharding(self._local_mesh(stage), P())
 
     def _act_sharding(self, stage: int) -> NamedSharding:
-        return NamedSharding(self._meshes()[stage], P(STAGE_DP_AXIS))
+        return NamedSharding(self._local_mesh(stage), P(STAGE_DP_AXIS))
 
     def _place_act(self, x, stage: int):
         shd = self._act_sharding(stage)
@@ -125,6 +229,27 @@ class MultiNodeChainList:
                          stage_inputs=stage_inputs or {})
 
     __call__ = apply
+
+    def _pick_anchor(self, params_list, s: int):
+        """Anchor pytree for a cross-process recv's backward: stage ``s``'s
+        params if they contain an inexact leaf, else any local stage's.
+        The anchor must be part of what the caller differentiates — JAX
+        prunes the reverse transfer otherwise (see :func:`cross_recv`) and
+        the PRODUCER process would then block forever awaiting the
+        cotangent, a hang with no pointer to the real cause."""
+        candidates = [params_list[s]] + [
+            p for i, p in enumerate(params_list)
+            if i != s and self.is_local_stage(i)]
+        for cand in candidates:
+            if cand is not None and any(
+                    jnp.issubdtype(jnp.result_type(l), jnp.inexact)
+                    for l in jax.tree.leaves(cand)):
+                return cand
+        raise ValueError(
+            f"cross-process recv at stage {s} has no anchor: neither that "
+            "stage nor any other local stage has float parameters, so the "
+            "backward transfer would be pruned and the sending process "
+            "would hang waiting for the cotangent")
 
     def _stage_jit(self, s, mod):
         key = (s, id(mod))
@@ -158,10 +283,18 @@ class MultiNodeChainList:
                 "entry stage (or use stage_inputs)")
 
         outputs = []
+        cross_delegates: List[Any] = []
+        # Occurrence counters per (src, dst) stage pair.  Sends count at the
+        # producer's program position, recvs at the consumer's; both follow
+        # the same registration order on every process, so the i-th send of
+        # a pair meets the i-th recv and their packed DCN tags agree.
+        occ_send: dict = {}
+        occ_recv: dict = {}
         for s, (mod, rank_in, rank_out) in enumerate(self._links):
+            local = self.is_local_stage(s)
             received: List[Any] = []
             if rank_in is None:
-                if inputs:
+                if local and inputs:
                     if len(entry_stages) == 1:
                         received.extend(inputs)
                     else:
@@ -169,9 +302,31 @@ class MultiNodeChainList:
             else:
                 ranks = rank_in if isinstance(rank_in, (list, tuple)) else [rank_in]
                 for r in ranks:
-                    received.append(F.recv(
-                        self._comm, r, self_rank=s, tag=self._tag,
-                        device_put=lambda v, _s=s: self._place_act(v, _s)))
+                    src_local = self.is_local_stage(r)
+                    if local and src_local:
+                        received.append(F.recv(
+                            self._comm, r, self_rank=s, tag=self._tag,
+                            device_put=lambda v, _s=s: self._place_act(v, _s)))
+                    elif local:  # producer on another controller process
+                        occ = occ_recv[(r, s)] = occ_recv.get((r, s), 0)
+                        occ_recv[(r, s)] += 1
+                        anchor = (self._pick_anchor(params_list, s)
+                                  if init_stage_hook is None else None)
+                        shd = self._act_sharding(s)
+                        received.append(F.cross_recv(
+                            self._comm, self.stage_owner(r),
+                            tag=self._cross_tag(r, s, occ), anchor=anchor,
+                            device_put=lambda a, _shd=shd: jax.device_put(
+                                a, _shd)))
+            if not local:
+                # Not this controller's stage — its sends/recvs happen on
+                # its owner.  (Occurrence counters stay consistent without
+                # bookkeeping here: a (src, dst) pair's owners are fixed,
+                # so every occurrence of the pair is counted on the same
+                # two processes, in the shared registration order.)
+                if init_stage_hook is not None:
+                    params_list.append(None)
+                continue
             received.extend(stage_inputs.get(s, ()))
             args = tuple(received)
             if init_stage_hook is not None:
@@ -182,7 +337,14 @@ class MultiNodeChainList:
             else:
                 ranks = rank_out if isinstance(rank_out, (list, tuple)) else [rank_out]
                 for r in ranks:
-                    F.send(y, self._comm, r, self_rank=s, tag=self._tag)
+                    if self.is_local_stage(r):
+                        F.send(y, self._comm, r, self_rank=s, tag=self._tag)
+                    else:
+                        occ = occ_send[(s, r)] = occ_send.get((s, r), 0)
+                        occ_send[(s, r)] += 1
+                        cross_delegates.append(F.cross_send(
+                            y, self._comm, self.stage_owner(r),
+                            tag=self._cross_tag(s, r, occ)))
         leftovers = [k for k, q in channels.slots.items()
                      if q and k[2] == self._tag]
         if leftovers:
@@ -190,5 +352,16 @@ class MultiNodeChainList:
                 f"unconsumed sends on channels {leftovers}: some rank_out "
                 "has no matching rank_in consumer in this chain list")
         if not outputs:
+            if cross_delegates:
+                return (cross_delegates[0] if len(cross_delegates) == 1
+                        else jnp.concatenate(
+                            [d.ravel() for d in cross_delegates]))
             return None
+        if cross_delegates:
+            # Thread the cross-send delegates into the local outputs so the
+            # caller's single value_and_grad also drives those backwards.
+            tied = F.pseudo_connect(
+                jnp.concatenate([d.ravel() for d in cross_delegates]),
+                *outputs)
+            outputs = list(tied) if len(outputs) > 1 else [tied]
         return outputs[0] if len(outputs) == 1 else tuple(outputs)

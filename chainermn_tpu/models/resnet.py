@@ -91,12 +91,14 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.float32
     momentum: float = 0.9
+    norm_cls: Any = None  # default nn.BatchNorm; swap for perf probes/variants
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32, padding="SAME")
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        norm = partial(self.norm_cls or nn.BatchNorm,
+                       use_running_average=not train,
                        momentum=self.momentum, epsilon=1e-5,
                        dtype=self.dtype, param_dtype=jnp.float32)
         x = x.astype(self.dtype)

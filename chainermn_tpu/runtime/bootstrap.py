@@ -27,6 +27,24 @@ import os
 from typing import Optional
 
 
+def _tpu_metadata_present() -> bool:
+    """True when this host looks like part of a Cloud TPU slice.
+
+    On standard Cloud TPU VMs ``JAX_PLATFORMS`` is typically unset (the
+    TPU plugin is auto-discovered), so platform config alone cannot
+    decide whether the no-arg ``jax.distributed.initialize()`` pod path
+    should run.  Check the slice-metadata env the TPU runtime exports
+    (any one suffices).  Deliberately NOT a libtpu-presence check: the
+    wheel being installed says nothing about running on a slice, and a
+    false positive here costs an off-GCP metadata-server probe.
+    """
+    for var in ("TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+                "TPU_SKIP_MDS_QUERY", "TPU_ACCELERATOR_TYPE"):
+        if os.environ.get(var):
+            return True
+    return False
+
+
 def init_distributed(
     coordinator: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -53,11 +71,14 @@ def init_distributed(
     # initialize XLA and make jax.distributed.initialize() fail.
     if coordinator is None and num_processes is None and process_id is None:
         # TPU pod path: `jax.distributed.initialize()` with no args reads
-        # slice metadata.  Attempt it only when the configured platform
-        # looks like TPU; off-TPU stay single-controller.
+        # slice metadata.  Attempt it when the configured platform looks
+        # like TPU — or when Cloud TPU metadata is present even though
+        # JAX_PLATFORMS is unset (the common case: the TPU plugin is
+        # auto-discovered, nobody exports JAX_PLATFORMS).  Off-TPU stay
+        # single-controller.
         platforms = (os.environ.get("JAX_PLATFORMS")
                      or getattr(jax.config, "jax_platforms", None) or "")
-        if "tpu" in platforms:
+        if "tpu" in platforms or ("cpu" not in platforms and _tpu_metadata_present()):
             try:
                 jax.distributed.initialize()
             except RuntimeError as e:

@@ -100,6 +100,7 @@ class NativeTransport:
         self._closed = False
         self._inflight = 0
         self._cv = threading.Condition()
+        self._destroyed = threading.Event()
 
     def _enter(self):
         with self._cv:
@@ -157,14 +158,23 @@ class NativeTransport:
 
     def close(self):
         with self._cv:
-            if self._closed:
-                return
+            already_closing = self._closed
             self._closed = True  # new callers now fail fast in _enter
-        # Shutdown unblocks in-flight callers (fd shutdown + cv wakeups);
-        # it must run BEFORE waiting on them, or a blocked recv would pin
-        # close() for its full timeout.
-        self._lib.dcn_shutdown(self._handle)
-        with self._cv:
-            while self._inflight:
-                self._cv.wait()
-        self._lib.dcn_destroy(self._handle)
+        if already_closing:
+            # A concurrent closer won the race; close() returning must
+            # still mean "the winner's teardown finished", so wait for it.
+            self._destroyed.wait()
+            return
+        try:
+            # Shutdown unblocks in-flight callers (fd shutdown + cv
+            # wakeups); it must run BEFORE waiting on them, or a blocked
+            # recv would pin close() for its full timeout.
+            self._lib.dcn_shutdown(self._handle)
+            with self._cv:
+                while self._inflight:
+                    self._cv.wait()
+            self._lib.dcn_destroy(self._handle)
+        finally:
+            # Set even on failure: a raised close() must not convert every
+            # later close() into a permanent _destroyed.wait() hang.
+            self._destroyed.set()

@@ -124,3 +124,161 @@ def test_two_controller_training():
                                                  rel=1e-6)
     # and it trains
     assert results[0]["losses"][-1] < results[0]["losses"][0]
+
+
+_MP_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import chainermn_tpu
+
+chainermn_tpu.init_distributed(local_device_count=4)
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from chainermn_tpu.links import MultiNodeChainList, pseudo_loss
+
+assert jax.process_count() == 2 and jax.device_count() == 8
+
+comm = chainermn_tpu.create_communicator("naive")
+
+
+class Stage0(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.tanh(nn.Dense(16)(x))
+
+
+class Stage1(nn.Module):
+    @nn.compact
+    def __call__(self, h):
+        return nn.Dense(4)(h)
+
+
+model = MultiNodeChainList(comm)
+model.add_link(Stage0(), rank_in=None, rank_out=1)   # controller process 0
+model.add_link(Stage1(), rank_in=0, rank_out=None)   # controller process 1
+
+rng = np.random.RandomState(0)
+x = rng.randn(32, 8).astype(np.float32)
+y = (rng.rand(32) * 4).astype(np.int32)
+
+params = model.init(jax.random.key(0), x)
+opt = optax.sgd(0.1)
+opt_state = opt.init(params)
+
+
+def loss_fn(params_list, xb, yb):
+    out = model.apply(params_list, xb)
+    if model.owns_output:
+        return optax.softmax_cross_entropy_with_integer_labels(out, yb).mean()
+    return pseudo_loss(out)
+
+
+losses = []
+for i in range(6):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    losses.append(float(loss))
+
+print("RESULT " + json.dumps({"losses": losses,
+                              "owns_output": model.owns_output,
+                              "rank": comm.host_rank}))
+"""
+
+
+@pytest.mark.slow
+def test_two_controller_model_parallel_training():
+    """VERDICT round-1 'next #2': MultiNodeChainList with the first stage on
+    process 0's devices and the second on process 1's, gradients flowing
+    back across the controller boundary; loss parity vs the identical
+    single-process composition."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({
+            "CHAINERMN_TPU_COORDINATOR": coord,
+            "CHAINERMN_TPU_NUM_PROCESSES": "2",
+            "CHAINERMN_TPU_PROCESS_ID": str(r),
+            "CHAINERMN_TPU_REPO": repo,
+            "PYTHONPATH": repo,
+            "JAX_PLATFORMS": "cpu",
+            "JAX_NUM_CPU_DEVICES": "4",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MP_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    for r, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=300)
+        assert p.returncode == 0, (
+            f"rank {r} failed\nstderr:\n{stderr[-3000:]}\nstdout:\n{stdout}")
+        line = [l for l in stdout.splitlines() if l.startswith("RESULT ")]
+        assert line, stdout
+        results[r] = json.loads(line[0][len("RESULT "):])
+
+    # stage placement: exit stage owned by process 1, not process 0
+    assert results[0]["owns_output"] is False
+    assert results[1]["owns_output"] is True
+    mp_losses = results[1]["losses"]
+    # process 0 sees the pseudo-loss (0.0): its backward ran anyway --
+    # training only converges below if its encoder actually updated
+    assert all(l == 0.0 for l in results[0]["losses"])
+
+    # single-process reference: identical composition, same seeds/data
+    ref = _single_process_reference()
+    assert mp_losses == pytest.approx(ref, rel=2e-4)
+    assert mp_losses[-1] < mp_losses[0]
+
+
+def _single_process_reference():
+    """The same 2-stage chain trained in THIS process (single controller)."""
+    import flax.linen as nn
+    import jax
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.links import MultiNodeChainList
+
+    class Stage0(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.tanh(nn.Dense(16)(x))
+
+    class Stage1(nn.Module):
+        @nn.compact
+        def __call__(self, h):
+            return nn.Dense(4)(h)
+
+    comm = chainermn_tpu.create_communicator("naive")
+    model = MultiNodeChainList(comm)
+    model.add_link(Stage0(), rank_in=None, rank_out=1)
+    model.add_link(Stage1(), rank_in=0, rank_out=None)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (rng.rand(32) * 4).astype(np.int32)
+
+    params = model.init(jax.random.key(0), x)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+
+    def loss_fn(params_list, xb, yb):
+        logits = model.apply(params_list, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    losses = []
+    for i in range(6):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    return losses

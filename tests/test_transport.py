@@ -242,3 +242,44 @@ class TestNativeTransport:
             assert results[1][2] is None
         finally:
             [t.close() for t in tps]
+
+    def test_concurrent_close_waits_for_destroy(self):
+        """A close() that loses the race must not return until the winning
+        close() has actually destroyed the native handle (native.py close
+        contract: 'close() returned' always implies 'handle freed')."""
+        import time
+
+        from chainermn_tpu.runtime.native import NativeTransport
+
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([lambda r, s, c: NativeTransport(r, s, c)] * 2, coord)
+        # Park a receiver in-flight so the winning close() has work to
+        # drain, widening the window the losing close() must wait out.
+        recv_t = threading.Thread(
+            target=lambda: _swallow(lambda: tps[0].recv(1, 99, timeout=30)))
+        recv_t.start()
+        time.sleep(0.2)
+        destroyed_when_returned = []
+
+        def closer():
+            tps[0].close()
+            destroyed_when_returned.append(tps[0]._destroyed.is_set())
+
+        closers = [threading.Thread(target=closer) for _ in range(2)]
+        closers[0].start()
+        time.sleep(0.05)
+        closers[1].start()
+        for t in closers:
+            t.join(15)
+        assert not any(t.is_alive() for t in closers), "close() hung"
+        # every close() return happened after dcn_destroy completed
+        assert destroyed_when_returned == [True, True]
+        recv_t.join(10)
+        tps[1].close()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
