@@ -1,25 +1,44 @@
-"""Fused attention forward — Pallas TPU kernel (flash-attention style).
+"""Fused attention — Pallas TPU kernels, forward AND backward.
 
 **Beyond-reference native kernel** (the reference's native surface was
 CUDA elementwise strings — SURVEY.md §2.3; this is the TPU analogue for
 the attention hot op used by the sequence-parallel extension).
 
-One `pallas_call` program per (batch*head, q-tile): the q tile lives in
-VMEM, K/V for the whole (local) sequence stream through VMEM, and the
-softmax is computed online (running max / denominator, never a full
-[T, T] score matrix in HBM).  MXU does the two matmuls per K/V tile; the
-online-softmax rescale rides the VPU.
+Forward: one `pallas_call` program per (batch*head, q-tile): the q tile
+lives in VMEM, K/V for the whole (local) sequence stream through VMEM,
+and the softmax is computed online (running max / denominator, never a
+full [T, T] score matrix in HBM).  MXU does the two matmuls per K/V
+tile; the online-softmax rescale rides the VPU.  The per-row logsumexp
+is written out as a residual so the backward never re-derives it.
 
-Scope: per-shard sequence lengths where K/V fit VMEM (T*D*4B each —
+Backward: two Pallas kernels in the standard flash-gradient shape —
+one program per K/V tile accumulating (dk, dv) over q tiles, one
+program per Q tile accumulating dq over K/V tiles — each recomputing
+its score tile from q/k and the saved logsumexp, so the [T, T] matrix
+is materialized in NEITHER direction and training memory stays
+O(T * block) end to end.  A pure-XLA blockwise backward with identical
+math is kept (``bwd_impl="blockwise"``) as the cross-check oracle for
+the gradient-parity tests.
+
+Masking and dropout:
+
+* ``causal`` — lower-triangular mask; fully-masked K/V tiles are
+  skipped (forward) / never visited (backward).
+* ``q_segment_ids``/``kv_segment_ids`` ([B, T] int32) — attention is
+  allowed only where the ids match, which expresses packed-sequence and
+  padding masks (give padding a sentinel id that matches nothing).
+  Fully-masked rows produce zero output and zero gradients.
+* ``dropout_rate``/``dropout_seed`` — attention-weight dropout applied
+  after normalization with inverted scaling (kept weights / keep_p).
+  The mask is a counter-based hash of (seed, batch*head, q_pos, k_pos)
+  computed identically in forward, backward, and the blockwise oracle —
+  nothing random is stored, so the recompute-based backward stays exact.
+
+Scope: per-shard sequence lengths where K/V fit VMEM (T*D*2B each —
 thousands of positions at D=64..128), which is exactly the per-device
 block regime of :func:`chainermn_tpu.parallel.sequence.ring_attention` /
-``ulysses_attention`` (pass ``attn_fn=flash_attention``).
-
-Differentiation: forward runs the fused kernel; backward is the standard
-blockwise flash gradient (recompute softmax stats, then per-tile
-dq/dk/dv accumulation) — the [T, T] matrix is materialized in NEITHER
-direction, so training memory stays O(T * block) too.  Off-TPU the
-kernel runs in Pallas interpret mode so the CPU test mesh exercises the
+``ulysses_attention`` (pass ``attn_fn=flash_attention``).  Off-TPU the
+kernels run in Pallas interpret mode so the CPU test mesh exercises the
 same code path.
 """
 
@@ -42,16 +61,84 @@ except Exception:  # pragma: no cover
 _BLOCK_Q = 256
 _BLOCK_K = 256
 _NEG_INF = -1e30
+_LSE_SENTINEL = 1e30  # lse for fully-masked rows: exp(s - sentinel) == 0
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k):
-    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, BQ, D]
-    # Keep matmul inputs in their storage dtype (bf16 rides the MXU at
-    # full rate; f32 would quarter it) and accumulate in f32.
+def _keep_mask(seed_u32, bh_idx, q_pos, k_pos, rate):
+    """Deterministic dropout keep-mask from a counter-based hash.
+
+    ``q_pos``/``k_pos`` are GLOBAL positions (broadcastable int32
+    arrays), so forward and backward — which tile the [T, T] plane
+    differently — reproduce the identical mask.  Murmur3-finalizer
+    rounds give well-mixed bits from pure uint32 VPU arithmetic (no
+    stateful PRNG, works under both compiled and interpret modes).
+    """
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         ^ (bh_idx.astype(jnp.uint32) if hasattr(bh_idx, "astype")
+            else jnp.uint32(bh_idx)) * jnp.uint32(0xC2B2AE35)
+         ^ seed_u32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = min(int(rate * 2 ** 32), 2 ** 32 - 1)
+    return x >= jnp.uint32(thresh)
+
+
+def _shape_like(template, shape, dtype):
+    """ShapeDtypeStruct carrying ``template``'s varying-axes (vma) metadata
+    when the JAX version supports it — needed for shard_map composition."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(template).vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _unpack_rest(rest, has_seg, dropout_rate):
+    """Split a kernel's trailing refs into (qseg, kseg, seed, outputs) —
+    shared by all three kernels so the optional-input threading lives once."""
+    idx = 0
+    qseg_ref = kseg_ref = seed_ref = None
+    if has_seg:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        idx = 2
+    if dropout_rate > 0.0:
+        seed_ref = rest[idx]
+        idx += 1
+    return qseg_ref, kseg_ref, seed_ref, rest[idx:]
+
+
+def _mask_tile(causal, q_pos, k_pos, seg_q, seg_k):
+    """[bq, bk] bool allow-mask (or None when nothing masks)."""
+    mask = None
+    if causal:
+        mask = q_pos >= k_pos
+    if seg_q is not None:
+        m2 = seg_q[:, None] == seg_k[None, :]
+        mask = m2 if mask is None else (mask & m2)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
+                has_seg, dropout_rate):
+    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; optional qseg [1, BQ],
+    # kseg [1, T], seed [1, 1]; outputs o [1, BQ, D], lse [1, BQ].
+    qseg_ref, kseg_ref, seed_ref, (o_ref, lse_ref) = _unpack_rest(
+        rest, has_seg, dropout_rate)
+
     q = q_ref[0]                                         # [BQ, D]
     t = k_ref.shape[1]
     bq = q.shape[0]
     q_off = pl.program_id(1) * bq
+    bh_idx = pl.program_id(0)
+    seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, carry):
         acc, m, l = carry
@@ -61,18 +148,29 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k):
         # so results match it to tight tolerance
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = q_off + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        seg_q = qseg_ref[0] if has_seg else None
+        seg_k = (kseg_ref[0, pl.dslice(j * block_k, block_k)]
+                 if has_seg else None)
+        mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if mask is not None:
+            # when a whole row of the tile is masked, s - m_new == 0 and
+            # exp would give 1 — zero the masked entries explicitly
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed, bh_idx, q_pos, k_pos, dropout_rate)
+            p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            p_use = p
         acc_new = acc * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -86,10 +184,15 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k):
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+    empty = l == 0.0
+    o_ref[0] = (acc / jnp.where(empty, 1.0, l)).astype(o_ref.dtype)
+    lse = jnp.where(empty[:, 0], _LSE_SENTINEL, m[:, 0] + jnp.log(
+        jnp.where(empty[:, 0], 1.0, l[:, 0])))
+    lse_ref[0] = lse.astype(jnp.float32)
 
 
-def _forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
+             dropout_rate, interpret):
     b, t, h, d = q.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bq = min(block_q, t)
@@ -102,113 +205,295 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     qf, kf, vf = fold(q), fold(k), fold(v)
+    has_seg = qseg is not None
 
-    kern = functools.partial(_kernel, sm_scale=scale, causal=causal,
-                             block_k=bk)
+    kern = functools.partial(_fwd_kernel, sm_scale=scale, causal=causal,
+                             block_k=bk, has_seg=has_seg,
+                             dropout_rate=dropout_rate)
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
-    # Inside shard_map the output must carry the inputs' varying-axes
+    ins = [qf, kf, vf]
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
+    ]
+    if has_seg:
+        # segment ids are per-batch; heads share them (index map i // h)
+        ins += [qseg, kseg]
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda i, j: (i // h, j), **kw),
+            pl.BlockSpec((1, t), lambda i, j: (i // h, 0), **kw),
+        ]
+    if dropout_rate > 0.0:
+        ins.append(seed.reshape(1, 1))
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw))
+    # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
-    try:
-        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype,
-                                         vma=jax.typeof(qf).vma)
-    except (AttributeError, TypeError):
-        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
-    out = pl.pallas_call(
+    out_shape = [_shape_like(qf, (b * h, t, d), q.dtype),
+                 _shape_like(qf, (b * h, t), jnp.float32)]
+    out, lse = pl.pallas_call(
         kern,
         grid=(b * h, t // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+                   pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw)],
         out_shape=out_shape,
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    )(*ins)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False,
-                    sm_scale: Optional[float] = None,
-                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K):
-    """Fused softmax attention: [B, T, H, D] q/k/v -> [B, T, H, D].
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
 
-    Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
-    signature minus offsets); pass as ``attn_fn=`` to
-    ``ulysses_attention`` for a fused inner kernel.  ``block_q``/
-    ``block_k`` tune the tile sizes (sequence length must be a multiple
-    of each, or fit a single tile).
+def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
+                sm_scale, causal, block_q, has_seg, dropout_rate):
+    # q_ref/g_ref: [1, T, D] (resident); k_ref/v_ref: [1, BK, D] tile;
+    # lse_ref/delta_ref: [1, T]; outputs dk/dv: [1, BK, D].
+    qseg_ref, kseg_ref, seed_ref, (dk_ref, dv_ref) = _unpack_rest(
+        rest, has_seg, dropout_rate)
+
+    k = k_ref[0]                                          # [BK, D]
+    v = v_ref[0]
+    t = q_ref.shape[1]
+    bk = k.shape[0]
+    d = k.shape[1]
+    bq = block_q
+    k_off = pl.program_id(1) * bk
+    bh_idx = pl.program_id(0)
+    seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    seg_k = (kseg_ref[0] if has_seg else None)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * bq, bq), :]
+        g = g_ref[0, pl.dslice(i * bq, bq), :]
+        lse = lse_ref[0, pl.dslice(i * bq, bq)]
+        delta = delta_ref[0, pl.dslice(i * bq, bq)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        seg_q = qseg_ref[0, pl.dslice(i * bq, bq)] if has_seg else None
+        mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
+        a = jnp.exp(s - lse[:, None])                     # normalized probs
+        if mask is not None:
+            a = jnp.where(mask, a, 0.0)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed, bh_idx, q_pos, k_pos, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            a_drop = jnp.where(keep, a * inv, 0.0)
+            da = jnp.where(keep, dp * inv, 0.0)
+        else:
+            a_drop = a
+            da = dp
+        dv = dv + jax.lax.dot_general(
+            a_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = a * (da - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    n_q = t // bq
+    start = (k_off // bq) if causal else 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
+               sm_scale, causal, block_k, has_seg, dropout_rate):
+    # q_ref/g_ref: [1, BQ, D] tile; k_ref/v_ref: [1, T, D] (resident);
+    # lse_ref/delta_ref: [1, BQ]; output dq: [1, BQ, D].
+    qseg_ref, kseg_ref, seed_ref, (dq_ref,) = _unpack_rest(
+        rest, has_seg, dropout_rate)
+
+    q = q_ref[0]
+    g = g_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    t = k_ref.shape[1]
+    bq = q.shape[0]
+    d = q.shape[1]
+    bk = block_k
+    q_off = pl.program_id(1) * bq
+    bh_idx = pl.program_id(0)
+    seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    seg_q = qseg_ref[0] if has_seg else None
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * bk, bk), :]
+        v = v_ref[0, pl.dslice(j * bk, bk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        seg_k = kseg_ref[0, pl.dslice(j * bk, bk)] if has_seg else None
+        mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
+        a = jnp.exp(s - lse[:, None])
+        if mask is not None:
+            a = jnp.where(mask, a, 0.0)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed, bh_idx, q_pos, k_pos, dropout_rate)
+            da = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            da = dp
+        ds = a * (da - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    n_k = t // bk
+    if causal:
+        n_k = jnp.minimum(n_k, (q_off + bq + bk - 1) // bk)
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq = jax.lax.fori_loop(0, n_k, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
+                     sm_scale, block_q, block_k, dropout_rate, interpret):
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(out), fold(g)
+    # delta = rowsum(dO * O): cheap fused elementwise+reduce, XLA's job
+    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    has_seg = qseg is not None
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    shape = lambda s, dt: _shape_like(qf, s, dt)
+    full = lambda: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw)
+    vec_full = lambda: pl.BlockSpec((1, t), lambda i, j: (i, 0), **kw)
+    seg_specs = lambda qs, ks: [
+        pl.BlockSpec(qs, (lambda i, j: (i // h, 0)) if qs[1] == t
+                     else (lambda i, j: (i // h, j)), **kw),
+        pl.BlockSpec(ks, (lambda i, j: (i // h, 0)) if ks[1] == t
+                     else (lambda i, j: (i // h, j)), **kw)]
+    seed_in = ([] if dropout_rate == 0.0 else [seed.reshape(1, 1)])
+    seed_spec = ([] if dropout_rate == 0.0 else
+                 [pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw)])
+
+    dkv_kern = functools.partial(
+        _dkv_kernel, sm_scale=scale, causal=causal, block_q=bq,
+        has_seg=has_seg, dropout_rate=dropout_rate)
+    ins = [qf, gf, kf, vf, lse, delta]
+    in_specs = [full(), full(),
+                pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
+                pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
+                vec_full(), vec_full()]
+    if has_seg:
+        ins += [qseg, kseg]
+        in_specs += seg_specs((1, t), (1, bk))
+    ins += seed_in
+    in_specs += seed_spec
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(b * h, t // bk),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
+                   pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw)],
+        out_shape=[shape((b * h, t, d), k.dtype),
+                   shape((b * h, t, d), v.dtype)],
+        interpret=interpret,
+    )(*ins)
+
+    dq_kern = functools.partial(
+        _dq_kernel, sm_scale=scale, causal=causal, block_k=bk,
+        has_seg=has_seg, dropout_rate=dropout_rate)
+    ins = [qf, gf, kf, vf, lse, delta]
+    in_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+                full(), full(),
+                pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw),
+                pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw)]
+    if has_seg:
+        ins += [qseg, kseg]
+        in_specs += seg_specs((1, bq), (1, t))
+    ins += seed_in
+    in_specs += seed_spec
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(b * h, t // bq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+        out_shape=shape((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(*ins)
+
+    unfold = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
+                        sm_scale, block_k, dropout_rate):
+    """Pure-XLA blockwise flash backward — the gradient-parity oracle.
+
+    Identical math to the Pallas kernels (saved-lse softmax, the same
+    hash-based dropout mask), expressed as a `lax.scan` over K/V tiles so
+    the [T, T] matrix is still never materialized.
     """
-    interpret = jax.default_backend() != "tpu"
-    return _forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-
-
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, out)
-
-
-def _bwd(causal, sm_scale, block_q, block_k, res, g):
-    """Blockwise flash backward — the [T, T] score matrix is never
-    materialized in the backward either.
-
-    Standard flash-attention gradient algebra, tile by tile (j over K/V
-    tiles): recompute ``s_ij``/``p_ij`` from the saved q/k and the
-    softmax stats, then
-
-        dv_j  = p_ij^T @ dO_i
-        dp_ij = dO_i @ v_j^T
-        ds_ij = p_ij * (dp_ij - D_i) * scale,  D_i = rowsum(dO_i * O_i)
-        dq_i += ds_ij @ k_j ;  dk_j = ds_ij^T @ q_i
-
-    The softmax stats (m, l) are recomputed with one extra blockwise pass
-    (primal math only — no autodiff residuals), keeping peak memory at
-    O(T * block_k) per (batch, head) in both passes.
-    """
-    q, k, v, out = res
     b, t, h, d = q.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bk = min(block_k, t)
-    if t % bk:
-        raise ValueError(f"sequence length {t} not divisible by block_k {bk}")
     n = t // bk
     # [B, T, H, D] -> [B, H, T, D] f32 working layout
     tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
     qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
+    lseT = lse.reshape(b, h, t)
     q_pos = jnp.arange(t)
-
-    def stats_fold(carry, j):
-        m, l = carry
-        kb = jax.lax.dynamic_slice_in_dim(kT, j * bk, bk, axis=2)
-        s = jnp.einsum("bhtd,bhsd->bhts", qT, kb,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            mask = q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(-1))
-        l_new = l * jnp.exp(m - m_new) + jnp.exp(
-            s - m_new[..., None]).sum(-1)
-        return (m_new, l_new), None
-
-    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
-    (m, l), _ = jax.lax.scan(stats_fold, (m0, l0), jnp.arange(n))
-    l = jnp.where(l == 0.0, 1.0, l)
+    bh_idx = jnp.arange(b * h).reshape(b, h, 1, 1)
     D = (gT * oT).sum(-1)                                  # [B, H, T]
+    inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
+
+    def tile_mask(j):
+        mask = None
+        if causal:
+            mask = (q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
+                    )[None, None]
+        if qseg is not None:
+            kseg_j = jax.lax.dynamic_slice_in_dim(kseg, j * bk, bk, axis=1)
+            m2 = (qseg[:, None, :, None] == kseg_j[:, None, None, :])
+            mask = m2 if mask is None else (mask & m2)
+        return mask
+
+    def keep(j):
+        if dropout_rate == 0.0:
+            return None
+        k_pos = (j * bk + jnp.arange(bk))[None, None, None, :]
+        return _keep_mask(seed.astype(jnp.uint32), bh_idx,
+                          q_pos[None, None, :, None], k_pos, dropout_rate)
 
     def grad_fold(dq, j):
         kb = jax.lax.dynamic_slice_in_dim(kT, j * bk, bk, axis=2)
         vb = jax.lax.dynamic_slice_in_dim(vT, j * bk, bk, axis=2)
         s = jnp.einsum("bhtd,bhsd->bhts", qT, kb,
                        preferred_element_type=jnp.float32) * scale
-        if causal:
-            mask = q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        p = jnp.exp(s - m[..., None]) / l[..., None]       # [B, H, T, bk]
-        dv_j = jnp.einsum("bhts,bhtd->bhsd", p, gT)
+        a = jnp.exp(s - lseT[..., None])
+        mask = tile_mask(j)
+        if mask is not None:
+            a = jnp.where(mask, a, 0.0)
         dp = jnp.einsum("bhtd,bhsd->bhts", gT, vb)
-        ds = p * (dp - D[..., None]) * scale
+        km = keep(j)
+        if km is not None:
+            a_drop = jnp.where(km, a * inv, 0.0)
+            da = jnp.where(km, dp * inv, 0.0)
+        else:
+            a_drop = a
+            da = dp
+        dv_j = jnp.einsum("bhts,bhtd->bhsd", a_drop, gT)
+        ds = a * (da - D[..., None]) * scale
         dq = dq + jnp.einsum("bhts,bhsd->bhtd", ds, kb)
         dk_j = jnp.einsum("bhts,bhtd->bhsd", ds, qT)
         return dq, (dk_j, dv_j)
@@ -221,6 +506,91 @@ def _bwd(causal, sm_scale, block_q, block_k, res, g):
     return (back(dq, q), back(merge(dk_tiles), k), back(merge(dv_tiles), v))
 
 
-flash_attention.defvjp(_fwd, _bwd)
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, qseg, kseg, seed, dropout_rate, causal, sm_scale,
+           block_q, block_k, bwd_impl):
+    interpret = jax.default_backend() != "tpu"
+    out, _ = _forward(q, k, v, qseg, kseg, seed, causal, sm_scale,
+                      block_q, block_k, dropout_rate, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, qseg, kseg, seed, dropout_rate, causal, sm_scale,
+               block_q, block_k, bwd_impl):
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _forward(q, k, v, qseg, kseg, seed, causal, sm_scale,
+                        block_q, block_k, dropout_rate, interpret)
+    return out, (q, k, v, out, lse, qseg, kseg, seed)
+
+
+def _flash_bwd(dropout_rate, causal, sm_scale, block_q, block_k, bwd_impl,
+               res, g):
+    q, k, v, out, lse, qseg, kseg, seed = res
+    if bwd_impl == "pallas":
+        interpret = jax.default_backend() != "tpu"
+        dq, dk, dv = _pallas_backward(
+            q, k, v, out, lse, qseg, kseg, seed, g, causal, sm_scale,
+            block_q, block_k, dropout_rate, interpret)
+    elif bwd_impl == "blockwise":
+        dq, dk, dv = _blockwise_backward(
+            q, k, v, out, lse, qseg, kseg, seed, g, causal, sm_scale,
+            block_k, dropout_rate)
+    else:
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r} "
+                         "(expected 'pallas' or 'blockwise')")
+    return dq, dk, dv, None, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K,
+                    *, q_segment_ids=None, kv_segment_ids=None,
+                    dropout_rate: float = 0.0, dropout_seed=None,
+                    bwd_impl: str = "pallas"):
+    """Fused softmax attention: [B, T, H, D] q/k/v -> [B, T, H, D].
+
+    Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
+    signature minus offsets); pass as ``attn_fn=`` to
+    ``ulysses_attention`` for a fused inner kernel.  ``block_q``/
+    ``block_k`` tune the tile sizes (sequence length must be a multiple
+    of each, or fit a single tile).
+
+    Extra keyword-only features:
+
+    * ``q_segment_ids`` / ``kv_segment_ids`` — [B, T] int32 ids;
+      position pairs attend only when ids match (packed sequences,
+      padding).  Passing either defaults the other to zeros.
+    * ``dropout_rate`` + ``dropout_seed`` — attention dropout; the seed
+      is a traced uint32 scalar (vary it per training step).
+    * ``bwd_impl`` — "pallas" (default, fused backward kernels) or
+      "blockwise" (pure-XLA oracle with identical math).
+    """
+    if (q_segment_ids is not None) or (kv_segment_ids is not None):
+        if q_segment_ids is None:
+            q_segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
+        if kv_segment_ids is None:
+            kv_segment_ids = jnp.zeros(k.shape[:2], jnp.int32)
+        q_segment_ids = q_segment_ids.astype(jnp.int32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)
+    dropout_rate = float(dropout_rate)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.uint32)
+    else:
+        dropout_seed = None
+    return _flash(q, k, v, q_segment_ids, kv_segment_ids, dropout_seed,
+                  dropout_rate, bool(causal), sm_scale, int(block_q),
+                  int(block_k), bwd_impl)
+
 
 __all__ = ["flash_attention"]

@@ -60,6 +60,108 @@ def test_gradients_match_unfused(seed=2):
                                    err_msg=f"grad wrt {name}")
 
 
+def _masked_reference(q, k, v, allow, causal=False):
+    """Dense-mask oracle: softmax attention with an explicit [B,T,T] mask."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bthd,bshd->bhts", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        allow = allow & (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[
+            None]
+    s = jnp.where(allow[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows -> zero output
+    return jnp.einsum("bhts,bshd->bthd", p, v).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_mask_matches_dense_oracle(causal):
+    q, k, v = _qkv(5)
+    rng = np.random.RandomState(6)
+    seg = jnp.asarray(rng.randint(0, 3, size=(B, T)), jnp.int32)
+    got = flash_attention(q, k, v, causal,
+                          q_segment_ids=seg, kv_segment_ids=seg)
+    allow = seg[:, :, None] == seg[:, None, :]
+    want = _masked_reference(q, k, v, allow, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_fully_masked_rows_zero_output_and_grads():
+    q, k, v = _qkv(7)
+    # q rows with segment id 9 match nothing on the kv side
+    qseg = jnp.zeros((B, T), jnp.int32).at[:, :64].set(9)
+    kseg = jnp.zeros((B, T), jnp.int32)
+
+    def loss(a, b, c):
+        return (flash_attention(a, b, c, False, q_segment_ids=qseg,
+                                kv_segment_ids=kseg) ** 2).sum()
+
+    out = flash_attention(q, k, v, False, q_segment_ids=qseg,
+                          kv_segment_ids=kseg)
+    assert np.allclose(np.asarray(out[:, :64]), 0.0)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert np.all(np.isfinite(np.asarray(g)))
+    # masked q rows contribute no gradient to q
+    assert np.allclose(np.asarray(grads[0][:, :64]), 0.0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_seg", [False, True])
+def test_pallas_bwd_matches_blockwise_oracle(causal, with_seg):
+    """The fused backward kernels against the pure-XLA blockwise path."""
+    q, k, v = _qkv(8)
+    kw = {}
+    if with_seg:
+        rng = np.random.RandomState(9)
+        seg = jnp.asarray(rng.randint(0, 2, size=(B, T)), jnp.int32)
+        kw = dict(q_segment_ids=seg, kv_segment_ids=seg)
+
+    def loss(impl):
+        def f(a, b, c):
+            return (flash_attention(a, b, c, causal, bwd_impl=impl,
+                                    **kw) ** 2).sum()
+        return f
+
+    got = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss("blockwise"), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"grad wrt {name}")
+
+
+def test_dropout_deterministic_and_scaled():
+    q, k, v = _qkv(10)
+    a1 = flash_attention(q, k, v, False, dropout_rate=0.3, dropout_seed=42)
+    a2 = flash_attention(q, k, v, False, dropout_rate=0.3, dropout_seed=42)
+    b1 = flash_attention(q, k, v, False, dropout_rate=0.3, dropout_seed=43)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b1))
+    # inverted scaling keeps the output mean roughly unchanged
+    base = flash_attention(q, k, v, False)
+    assert abs(float(jnp.mean(a1)) - float(jnp.mean(base))) < 5e-3
+
+
+def test_dropout_grads_match_blockwise_oracle():
+    q, k, v = _qkv(11)
+
+    def loss(impl):
+        def f(a, b, c):
+            return (flash_attention(a, b, c, True, dropout_rate=0.25,
+                                    dropout_seed=7, bwd_impl=impl) ** 2).sum()
+        return f
+
+    got = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss("blockwise"), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"grad wrt {name}")
+
+
 def test_rejects_indivisible_sequence():
     rng = np.random.RandomState(3)
     # T <= block size runs as one tile (any T); T > block size must divide
