@@ -87,7 +87,11 @@ class CommunicatorBase(abc.ABC):
     def scatter_obj(self, objs: Optional[List[Any]], root: int = 0) -> Any: ...
 
     @abc.abstractmethod
-    def allreduce_obj(self, obj: Any, op: str = "sum") -> Any: ...
+    def allreduce_obj(self, obj: Any,
+                      op: "str | Callable[[Any, Any], Any]" = "sum") -> Any:
+        """Reduce picklable objects across hosts.  ``op``: "sum"/"prod"/
+        "max"/"min" (applied structurally through dicts/lists, ndarray-aware)
+        or any binary callable for custom reducibles."""
 
     @abc.abstractmethod
     def barrier(self) -> None: ...

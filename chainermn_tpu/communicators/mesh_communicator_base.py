@@ -143,6 +143,14 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def intra_rank(self) -> int:
+        """HOST-level intra coordinate (this controller's first device).
+
+        The reference's ``intra_rank`` was per-GPU because one process drove
+        one GPU; here one controller drives many devices, so in
+        single-controller mode this is 0 — device-level coordinates exist
+        only inside an SPMD region: use :meth:`intra_axis_index` there (or
+        :meth:`axis_index` for the flat per-device rank).
+        """
         return self._local_coords()[1]
 
     @property
@@ -152,11 +160,28 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def inter_rank(self) -> int:
+        """HOST-level inter coordinate — see :attr:`intra_rank` for the
+        host-vs-device semantics caveat; inside SPMD use
+        :meth:`inter_axis_index`."""
         return self._local_coords()[0]
 
     @property
     def inter_size(self) -> int:
         return self.size // self.intra_size
+
+    def intra_axis_index(self):
+        """Device-level intra-node rank (position on the last data axis —
+        the ICI axis).  Only meaningful inside an SPMD region; this is the
+        device-granular analogue of the reference's per-GPU ``intra_rank``."""
+        return lax.axis_index(self._data_axes[-1])
+
+    def inter_axis_index(self):
+        """Device-level inter-node rank (flat position on the leading data
+        axes — the DCN-ish axes).  Only meaningful inside an SPMD region."""
+        if len(self._data_axes) == 1:
+            return jnp.zeros((), jnp.int32)
+        lead = self._data_axes[:-1]
+        return lax.axis_index(lead if len(lead) > 1 else lead[0])
 
     # ---- object plane ------------------------------------------------------
     def send_obj(self, obj, dest, tag=0):
@@ -266,8 +291,12 @@ class MeshCommunicator(CommunicatorBase):
             lambda v: lax.all_gather(v, self._axis_arg(), tiled=False), x)
 
     def gather(self, x, root: int = 0):
-        # SPMD has no asymmetric gather; every device gets the stacked result
-        # (root kept for API parity with the reference signature).
+        # SPMD programs produce the same output shape on every device, so an
+        # asymmetric root-only gather cannot exist inside one XLA program:
+        # every device gets the stacked result (an all_gather — ring cost
+        # ~bytes/link, the cheapest primitive that realizes these semantics
+        # on ICI).  ``root`` is kept for reference-signature parity only;
+        # host-level root-only gathers are ``gather_obj`` on the DCN plane.
         del root
         return self.allgather(x)
 
@@ -289,11 +318,21 @@ class MeshCommunicator(CommunicatorBase):
 
     def scatter(self, x, root: int = 0):
         """x: stacked [size, ...] (meaningful on root; SPMD requires the value
-        be present everywhere) -> this rank's slice."""
-        x = self.bcast(x, root=root)
+        be present everywhere) -> this rank's slice.
+
+        Implemented as a psum_scatter of the root-masked stack: device i
+        receives sum_j masked_j[i] = root's slice i.  One ring reduce-scatter
+        pass (~bytes/link) — half the wire traffic of the naive
+        bcast-then-slice (a full allreduce, ~2x bytes/link), and no device
+        ever materializes the [size, ...] stack it doesn't need.
+        """
         idx = self.axis_index()
-        return jax.tree.map(
-            lambda v: lax.dynamic_index_in_dim(v, idx, axis=0, keepdims=False), x)
+
+        def one(v):
+            masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+            return lax.psum_scatter(masked, self._axis_arg(), tiled=False)
+
+        return jax.tree.map(one, x)
 
     def reduce_scatter(self, x):
         return jax.tree.map(

@@ -23,23 +23,54 @@ import os
 import pickle
 from typing import Any, Callable, List, Optional
 
-_REDUCE_OPS = {
-    "sum": lambda xs: _tree_reduce(xs, lambda a, b: a + b),
-    "max": lambda xs: _tree_reduce(xs, max),
-    "min": lambda xs: _tree_reduce(xs, min),
+def _np():
+    import numpy
+    return numpy
+
+
+def _pair_max(a, b):
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        return _np().maximum(a, b)
+    return max(a, b)
+
+
+def _pair_min(a, b):
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        return _np().minimum(a, b)
+    return min(a, b)
+
+
+# Pairwise reducers applied structurally (dicts / lists / scalars / ndarrays).
+# The reference's allreduce_obj handled arbitrary reducibles over MPI ops
+# 〔communicator_base.py〕; names map to the MPI op set, and any binary
+# callable is accepted for custom reductions (applied at the object level —
+# the caller owns the structure in that case).
+_PAIR_OPS = {
+    "sum": lambda a, b: a + b,
+    "prod": lambda a, b: a * b,
+    "max": _pair_max,
+    "min": _pair_min,
 }
 
 
-def _tree_reduce(xs, op):
-    out = xs[0]
-    for x in xs[1:]:
-        if isinstance(out, dict):
-            out = {k: op(out[k], x[k]) for k in out}
-        elif isinstance(out, (list, tuple)):
-            out = type(out)(op(a, b) for a, b in zip(out, x))
-        else:
-            out = op(out, x)
-    return out
+def _structural(op):
+    def apply(a, b):
+        if isinstance(a, dict):
+            return {k: apply(a[k], b[k]) for k in a}
+        if isinstance(a, (list, tuple)):
+            return type(a)(apply(x, y) for x, y in zip(a, b))
+        return op(a, b)
+    return apply
+
+
+def _resolve_op(op):
+    if callable(op):
+        return op  # custom binary reducible — object-level
+    try:
+        return _structural(_PAIR_OPS[op])
+    except KeyError:
+        raise ValueError(f"unknown op {op!r} "
+                         f"(expected one of {sorted(_PAIR_OPS)} or a callable)")
 
 
 class ControlPlane(abc.ABC):
@@ -55,46 +86,117 @@ class ControlPlane(abc.ABC):
     def recv_obj(self, source: int, tag: int = 0) -> Any: ...
 
     def bcast_obj(self, obj: Any, root: int = 0, tag: int = 0) -> Any:
+        """Binomial-tree broadcast: O(log n) DCN hops on the critical path
+        (the reference got this for free from MPI's tree collectives
+        〔mpi_communicator_base.py〕; a rank-0-serial loop would be O(n))."""
         if self.size == 1:
             return obj
-        if self.rank == root:
-            for r in range(self.size):
-                if r != root:
-                    self.send_obj(obj, r, tag=tag)
-            return obj
-        return self.recv_obj(root, tag=tag)
+        vrank = (self.rank - root) % self.size
+        mask = 1
+        while mask < self.size:
+            if vrank & mask:
+                src = ((vrank ^ mask) + root) % self.size
+                obj = self.recv_obj(src, tag=tag)
+                break
+            mask <<= 1
+        # children: vrank + m for each power of two m below our receive bit
+        mask >>= 1
+        while mask > 0:
+            if vrank + mask < self.size:
+                dst = ((vrank + mask) + root) % self.size
+                self.send_obj(obj, dst, tag=tag)
+            mask >>= 1
+        return obj
+
+    def _tree_fold(self, obj: Any, root: int, tag: int,
+                   fold: Optional[Callable]) -> Optional[dict]:
+        """Binomial-tree combine toward ``root``.
+
+        With ``fold=None`` accumulates a {vrank: obj} dict (gather); with a
+        binary ``fold`` combines payloads pairwise at each hop (reduce) so
+        every edge carries one object, not a subtree list.
+        Returns the combined payload on root, None elsewhere.
+        """
+        vrank = (self.rank - root) % self.size
+        acc = obj if fold is not None else {vrank: obj}
+        mask = 1
+        while mask < self.size:
+            if vrank & mask:
+                dst = ((vrank ^ mask) + root) % self.size
+                self.send_obj(acc, dst, tag=tag)
+                return None
+            src_v = vrank + mask
+            if src_v < self.size:
+                got = self.recv_obj((src_v + root) % self.size, tag=tag)
+                acc = fold(acc, got) if fold is not None else {**acc, **got}
+            mask <<= 1
+        return acc
 
     def gather_obj(self, obj: Any, root: int = 0, tag: int = 0) -> Optional[List[Any]]:
         if self.size == 1:
             return [obj]
-        if self.rank == root:
-            out = []
-            for r in range(self.size):
-                out.append(obj if r == root else self.recv_obj(r, tag=tag))
-            return out
-        self.send_obj(obj, root, tag=tag)
-        return None
+        acc = self._tree_fold(obj, root, tag, fold=None)
+        if acc is None:
+            return None
+        return [acc[(r - root) % self.size] for r in range(self.size)]
 
     def allgather_obj(self, obj: Any, tag: int = 0) -> List[Any]:
         gathered = self.gather_obj(obj, root=0, tag=tag)
         return self.bcast_obj(gathered, root=0, tag=tag + 1)
 
     def scatter_obj(self, objs: Optional[List[Any]], root: int = 0, tag: int = 0) -> Any:
+        """Binomial-tree scatter: root hands each subtree its slice of the
+        list, so the root sends O(log n) messages instead of n-1 (total
+        payload-hops grow by the tree depth — the standard MPI small-message
+        trade of bytes for latency)."""
         if self.size == 1:
             return objs[0]
+        vrank = (self.rank - root) % self.size
         if self.rank == root:
             assert objs is not None and len(objs) == self.size
-            for r in range(self.size):
-                if r != root:
-                    self.send_obj(objs[r], r, tag=tag)
-            return objs[root]
-        return self.recv_obj(root, tag=tag)
+            sub = {i: objs[(i + root) % self.size] for i in range(self.size)}
+            mask = 1
+            while mask < self.size:
+                mask <<= 1
+            mask >>= 1
+        else:
+            sub = None
+            mask = 1
+            while mask < self.size:
+                if vrank & mask:
+                    sub = self.recv_obj(((vrank ^ mask) + root) % self.size,
+                                        tag=tag)
+                    break
+                mask <<= 1
+            mask >>= 1
+        # forward each child its half of our subtree {vrank: obj} table
+        # (invariant: sub holds exactly [vrank, vrank + 2*mask) ∩ [0, size))
+        while mask > 0:
+            child = vrank + mask
+            if child < self.size:
+                child_share = {i: o for i, o in sub.items()
+                               if child <= i < child + mask}
+                self.send_obj(child_share, ((child + root) % self.size),
+                              tag=tag)
+                sub = {i: o for i, o in sub.items() if i not in child_share}
+            mask >>= 1
+        return sub[vrank]
 
-    def allreduce_obj(self, obj: Any, op: str = "sum", tag: int = 0) -> Any:
+    def allreduce_obj(self, obj: Any, op="sum", tag: int = 0) -> Any:
         """Reference analogue: ``allreduce_obj`` on the communicator base —
-        reduce pickled objects (numbers / dicts / nested) across hosts."""
-        xs = self.allgather_obj(obj, tag=tag)
-        return _REDUCE_OPS[op](xs)
+        reduce pickled objects (numbers / dicts / nested ndarrays) across
+        hosts.  ``op`` is "sum"/"prod"/"max"/"min" (applied structurally
+        through dicts/lists, ndarray-aware) or any binary callable for
+        custom reducibles.  Tree-reduce up + tree-bcast down: each DCN edge
+        carries ONE combined object and the critical path is O(log n).
+
+        Note: tree order ≠ serial left-fold order, so float sums can differ
+        in the last ulp across world sizes (deterministic for a fixed
+        size/topology) — same caveat as MPI's tree allreduce.
+        """
+        fold = _resolve_op(op)
+        acc = self._tree_fold(obj, 0, tag, fold=fold)
+        return self.bcast_obj(acc, root=0, tag=tag + 1)
 
     def barrier(self, tag: int = 900) -> None:
         self.allgather_obj(None, tag=tag)
