@@ -47,10 +47,22 @@ def _attend(impl: str, axis_name, q, k, v, causal: bool):
 
 
 class Block(nn.Module):
+    """Pre-LN transformer block.  With ``moe_experts > 0`` the dense MLP is
+    replaced by an expert-parallel MoE MLP
+    (:class:`chainermn_tpu.parallel.expert.ExpertParallelMLP`) over
+    ``moe_axis``; the load-balancing aux loss and overflow fraction are
+    sowed into the ``"moe_stats"`` collection (retrieve with
+    ``mutable=["moe_stats"]`` and add ``aux_weight * sum(aux_loss)`` to the
+    training loss)."""
+
     n_heads: int
     attention_impl: str = "xla"
     axis_name: Any = None
     dtype: Any = jnp.float32
+    moe_experts: int = 0          # 0 = dense MLP
+    moe_top_k: int = 1
+    moe_axis: Any = "ep"
+    moe_capacity: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
@@ -71,6 +83,19 @@ class Block(nn.Module):
         x = x + dense(d_model, "proj")(out.reshape(h.shape))
 
         h = ln("ln_mlp")(x)
+        if self.moe_experts:
+            from chainermn_tpu.parallel.expert import ExpertParallelMLP
+
+            y, stats = ExpertParallelMLP(
+                hidden=4 * d_model, axis_name=self.moe_axis,
+                capacity=self.moe_capacity, dtype=self.dtype,
+                top_k=self.moe_top_k, num_experts=self.moe_experts,
+                with_stats=True, name="moe")(h)
+            self.sow("moe_stats", "aux_loss", stats["aux_loss"])
+            self.sow("moe_stats", "overflow_fraction",
+                     stats["overflow_fraction"])
+            self.sow("moe_stats", "expert_load", stats["expert_load"])
+            return x + y
         h = nn.gelu(dense(4 * d_model, "up")(h))
         return x + dense(d_model, "down")(h)
 
@@ -91,6 +116,10 @@ class TransformerLM(nn.Module):
     attention_impl: str = "xla"
     axis_name: Any = None
     dtype: Any = jnp.float32
+    moe_experts: int = 0          # >0: MoE MLP in every block (EP over moe_axis)
+    moe_top_k: int = 1
+    moe_axis: Any = "ep"
+    moe_capacity: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -106,7 +135,9 @@ class TransformerLM(nn.Module):
         x = x + pos
         for i in range(self.n_layers):
             x = Block(self.n_heads, self.attention_impl, self.axis_name,
-                      self.dtype, name=f"block_{i}")(x)
+                      self.dtype, moe_experts=self.moe_experts,
+                      moe_top_k=self.moe_top_k, moe_axis=self.moe_axis,
+                      moe_capacity=self.moe_capacity, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_f")(x)
         logits = nn.Dense(self.vocab, dtype=self.dtype,

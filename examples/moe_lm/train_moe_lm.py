@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Mixture-of-experts LM training with expert parallelism.
+
+**Beyond-reference example** (the reference has no EP/MoE — SURVEY.md
+§2.4): a decoder-only LM whose MLPs are top-k-routed expert-parallel
+layers spread over the mesh's ``ep`` axis (tokens travel by all_to_all,
+experts stay put).  The training loss adds the Switch-style
+load-balancing auxiliary loss, and the script prints the global expert
+load and overflow fraction every log interval so routing collapse is
+visible, not silent.
+
+    python examples/moe_lm/train_moe_lm.py --experts 8 --top-k 2
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.models import TransformerLM
+
+
+def make_motif_task(n, seq_len, vocab, motif_len=16, seed=0):
+    rng = np.random.RandomState(seed)
+    motifs = (rng.rand(n, motif_len) * vocab).astype(np.int32)
+    reps = -(-seq_len // motif_len)
+    seqs = np.tile(motifs, (1, reps))[:, :seq_len]
+    noise = rng.rand(n, seq_len) < 0.02
+    seqs = np.where(noise, (rng.rand(n, seq_len) * vocab).astype(np.int32),
+                    seqs)
+    return jnp.asarray(seqs)
+
+
+def main():
+    p = argparse.ArgumentParser(description="chainermn_tpu MoE LM")
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--aux-weight", type=float, default=1e-2)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--batchsize", "-b", type=int, default=8)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    devices = jax.devices()
+    n_ep = min(len(devices), args.experts)
+    if args.experts % n_ep:
+        p.error(f"--experts must be a multiple of {n_ep} devices")
+    if args.batchsize % n_ep:
+        p.error(f"--batchsize must be divisible by {n_ep} devices")
+    mesh = Mesh(np.array(devices[:n_ep]), ("ep",))
+
+    model = TransformerLM(
+        vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
+        n_heads=args.heads, max_len=args.seq_len,
+        moe_experts=args.experts, moe_top_k=args.top_k, moe_axis="ep")
+
+    toks = make_motif_task(args.batchsize, args.seq_len, args.vocab,
+                           seed=args.seed)
+
+    # init inside the SPMD region (the router/expert shapes depend on the
+    # ep axis); batch is sharded over ep, params replicated
+    def init_body(tk):
+        return model.init(jax.random.key(args.seed), tk)
+
+    params = jax.jit(jax.shard_map(
+        init_body, mesh=mesh, in_specs=P("ep"), out_specs=P()))(toks)
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p_, tk):
+        def body(pp, tkk):
+            logits, mut = model.apply(pp, tkk, mutable=["moe_stats"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tkk[:, 1:]).mean()
+            ce = jax.lax.pmean(ce, "ep")
+            stats = mut["moe_stats"]
+            aux = sum(jax.tree.leaves(
+                {k: v for k, v in _collect(stats, "aux_loss").items()}))
+            over = _mean_stat(stats, "overflow_fraction")
+            load = _mean_stat(stats, "expert_load")
+            return ce + args.aux_weight * aux, (ce, aux, over, load)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(P(), P("ep")),
+                             out_specs=(P(), (P(), P(), P(), P())))(p_, tk)
+
+    def _collect(stats, key):
+        out = {}
+        for blk, d in stats.items():
+            if key in d:
+                out[blk] = d[key][0]
+        return out
+
+    def _mean_stat(stats, key):
+        vals = list(_collect(stats, key).values())
+        return sum(vals) / len(vals)
+
+    @jax.jit
+    def step(p_, s_, tk):
+        (l, extras), g = jax.value_and_grad(loss_fn, has_aux=True)(p_, tk)
+        updates, s_ = opt.update(g, s_, p_)
+        return optax.apply_updates(p_, updates), s_, l, extras
+
+    toks = jax.device_put(toks, NamedSharding(mesh, P("ep")))
+    sync_each = jax.default_backend() == "cpu"
+    print(f"experts={args.experts} top_k={args.top_k} devices={n_ep} "
+          f"backend={jax.default_backend()}", flush=True)
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt_state, loss, (ce, aux, over, load) = step(
+            params, opt_state, toks)
+        if sync_each or i % 10 == 0 or i == args.steps - 1:
+            lo = np.asarray(load)
+            print(f"step {i}: loss {float(ce):.4f} aux {float(aux):.3f} "
+                  f"overflow {float(over):.3f} "
+                  f"load[min/max] {lo.min():.3f}/{lo.max():.3f}", flush=True)
+    print(f"done in {time.time() - t0:.1f}s; final loss {float(ce):.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

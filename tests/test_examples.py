@@ -70,6 +70,23 @@ def test_long_context_ring_attention():
 
 
 @pytest.mark.slow
+def test_moe_lm_trains_balanced():
+    """Top-2 expert-parallel LM smoke: converges, reports routing stats,
+    and no expert hoards the tokens during training.  (Aux-loss *efficacy*
+    is pinned at unit level by test_aux_loss_gradient_pushes_toward_balance;
+    this guards the end-to-end pipeline.)"""
+    out = _run("moe_lm/train_moe_lm.py",
+               "--steps", "16", "--batchsize", "8", "--seq-len", "128",
+               "--d-model", "64", "--layers", "1", "--experts", "8",
+               "--top-k", "2")
+    assert "done in" in out
+    last = [l for l in out.splitlines() if l.startswith("step ")][-1]
+    # "load[min/max] a/b" — max below 0.5 means no expert hoards the tokens
+    mx = float(last.rsplit("/", 1)[1])
+    assert mx < 0.5, f"expert load collapsed: {last}"
+
+
+@pytest.mark.slow
 def test_parallel_convolution():
     """Channel-split conv demo (the reference's parallel_convolution)."""
     out = _run("parallel_convolution/train_parallel_conv.py",
