@@ -150,8 +150,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
                                 preferred_element_type=jnp.float32) * sm_scale
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
-        seg_q = qseg_ref[0] if has_seg else None
-        seg_k = (kseg_ref[0, pl.dslice(j * block_k, block_k)]
+        seg_q = qseg_ref[0, 0] if has_seg else None
+        seg_k = (kseg_ref[0, 0, pl.dslice(j * block_k, block_k)]
                  if has_seg else None)
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         if mask is not None:
@@ -188,7 +188,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
     o_ref[0] = (acc / jnp.where(empty, 1.0, l)).astype(o_ref.dtype)
     lse = jnp.where(empty[:, 0], _LSE_SENTINEL, m[:, 0] + jnp.log(
         jnp.where(empty[:, 0], 1.0, l[:, 0])))
-    lse_ref[0] = lse.astype(jnp.float32)
+    lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
 def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
@@ -218,11 +218,14 @@ def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
     ]
     if has_seg:
-        # segment ids are per-batch; heads share them (index map i // h)
-        ins += [qseg, kseg]
+        # segment ids are per-batch; heads share them (index map i // h).
+        # TPU tiling wants the last two block dims divisible by (8, 128) or
+        # equal to the array dims — a singleton row dim satisfies that, so
+        # host-side vectors ride as [*, 1, T].
+        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
         in_specs += [
-            pl.BlockSpec((1, bq), lambda i, j: (i // h, j), **kw),
-            pl.BlockSpec((1, t), lambda i, j: (i // h, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda i, j: (i // h, 0, j), **kw),
+            pl.BlockSpec((1, 1, t), lambda i, j: (i // h, 0, 0), **kw),
         ]
     if dropout_rate > 0.0:
         ins.append(seed.reshape(1, 1))
@@ -230,13 +233,13 @@ def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
     # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
     out_shape = [_shape_like(qf, (b * h, t, d), q.dtype),
-                 _shape_like(qf, (b * h, t), jnp.float32)]
+                 _shape_like(qf, (b * h, 1, t), jnp.float32)]
     out, lse = pl.pallas_call(
         kern,
         grid=(b * h, t // bq),
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-                   pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw)],
+                   pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw)],
         out_shape=out_shape,
         interpret=interpret,
     )(*ins)
@@ -264,18 +267,18 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
     k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    seg_k = (kseg_ref[0] if has_seg else None)
+    seg_k = (kseg_ref[0, 0] if has_seg else None)
 
     def body(i, carry):
         dk, dv = carry
         q = q_ref[0, pl.dslice(i * bq, bq), :]
         g = g_ref[0, pl.dslice(i * bq, bq), :]
-        lse = lse_ref[0, pl.dslice(i * bq, bq)]
-        delta = delta_ref[0, pl.dslice(i * bq, bq)]
+        lse = lse_ref[0, 0, pl.dslice(i * bq, bq)]
+        delta = delta_ref[0, 0, pl.dslice(i * bq, bq)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        seg_q = qseg_ref[0, pl.dslice(i * bq, bq)] if has_seg else None
+        seg_q = qseg_ref[0, 0, pl.dslice(i * bq, bq)] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])                     # normalized probs
         if mask is not None:
@@ -317,8 +320,8 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
 
     q = q_ref[0]
     g = g_ref[0]
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     t = k_ref.shape[1]
     bq = q.shape[0]
     d = q.shape[1]
@@ -327,7 +330,7 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
     q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    seg_q = qseg_ref[0] if has_seg else None
+    seg_q = qseg_ref[0, 0] if has_seg else None
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :]
@@ -335,7 +338,7 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        seg_k = kseg_ref[0, pl.dslice(j * bk, bk)] if has_seg else None
+        seg_k = kseg_ref[0, 0, pl.dslice(j * bk, bk)] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])
         if mask is not None:
@@ -369,18 +372,21 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(out), fold(g)
-    # delta = rowsum(dO * O): cheap fused elementwise+reduce, XLA's job
-    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    # delta = rowsum(dO * O): cheap fused elementwise+reduce, XLA's job.
+    # lse arrives as [B*H, 1, T] (see _forward's tiling note); delta gets
+    # the same singleton-row layout.
+    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+        -1, keepdims=True).swapaxes(1, 2)
     has_seg = qseg is not None
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     shape = lambda s, dt: _shape_like(qf, s, dt)
     full = lambda: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw)
-    vec_full = lambda: pl.BlockSpec((1, t), lambda i, j: (i, 0), **kw)
+    vec_full = lambda: pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0), **kw)
     seg_specs = lambda qs, ks: [
-        pl.BlockSpec(qs, (lambda i, j: (i // h, 0)) if qs[1] == t
-                     else (lambda i, j: (i // h, j)), **kw),
-        pl.BlockSpec(ks, (lambda i, j: (i // h, 0)) if ks[1] == t
-                     else (lambda i, j: (i // h, j)), **kw)]
+        pl.BlockSpec(qs, (lambda i, j: (i // h, 0, 0)) if qs[2] == t
+                     else (lambda i, j: (i // h, 0, j)), **kw),
+        pl.BlockSpec(ks, (lambda i, j: (i // h, 0, 0)) if ks[2] == t
+                     else (lambda i, j: (i // h, 0, j)), **kw)]
     seed_in = ([] if dropout_rate == 0.0 else [seed.reshape(1, 1)])
     seed_spec = ([] if dropout_rate == 0.0 else
                  [pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw)])
@@ -394,8 +400,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
                 pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
                 vec_full(), vec_full()]
     if has_seg:
-        ins += [qseg, kseg]
-        in_specs += seg_specs((1, t), (1, bk))
+        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
+        in_specs += seg_specs((1, 1, t), (1, 1, bk))
     ins += seed_in
     in_specs += seed_spec
     dk, dv = pl.pallas_call(
@@ -416,11 +422,11 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     in_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
                 pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
                 full(), full(),
-                pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw),
-                pl.BlockSpec((1, bq), lambda i, j: (i, j), **kw)]
+                pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw),
+                pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw)]
     if has_seg:
-        ins += [qseg, kseg]
-        in_specs += seg_specs((1, bq), (1, t))
+        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
+        in_specs += seg_specs((1, 1, bq), (1, 1, t))
     ins += seed_in
     in_specs += seed_spec
     dq = pl.pallas_call(
@@ -451,7 +457,7 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     # [B, T, H, D] -> [B, H, T, D] f32 working layout
     tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
     qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
-    lseT = lse.reshape(b, h, t)
+    lseT = lse.reshape(b, h, t)  # lse arrives [B*H, 1, T]
     q_pos = jnp.arange(t)
     bh_idx = jnp.arange(b * h).reshape(b, h, 1, 1)
     D = (gT * oT).sum(-1)                                  # [B, H, T]
