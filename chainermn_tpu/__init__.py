@@ -22,6 +22,12 @@ _EXPORTS = {
     "make_train_step": "chainermn_tpu.optimizers",
     "scatter_dataset": "chainermn_tpu.datasets",
     "scatter_index": "chainermn_tpu.datasets",
+    # real-data input pipeline (reference: examples-level preprocessing)
+    "Augment": "chainermn_tpu.datasets",
+    "ImageFolderDataset": "chainermn_tpu.datasets",
+    "NpzImageDataset": "chainermn_tpu.datasets",
+    "PrefetchIterator": "chainermn_tpu.datasets",
+    "normalize_image": "chainermn_tpu.datasets",
     "create_multi_node_evaluator": "chainermn_tpu.extensions",
     "AllreducePersistent": "chainermn_tpu.extensions",
     "create_multi_node_checkpointer": "chainermn_tpu.extensions",

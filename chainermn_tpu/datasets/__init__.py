@@ -1,3 +1,10 @@
+from chainermn_tpu.datasets.image_pipeline import (
+    Augment,
+    ImageFolderDataset,
+    NpzImageDataset,
+    PrefetchIterator,
+    normalize_image,
+)
 from chainermn_tpu.datasets.scatter_dataset import (
     SubDataset,
     TupleDataset,
@@ -7,8 +14,13 @@ from chainermn_tpu.datasets.scatter_dataset import (
 from chainermn_tpu.datasets.synthetic import make_classification
 
 __all__ = [
+    "Augment",
+    "ImageFolderDataset",
+    "NpzImageDataset",
+    "PrefetchIterator",
     "SubDataset",
     "TupleDataset",
+    "normalize_image",
     "scatter_dataset",
     "scatter_index",
     "make_classification",

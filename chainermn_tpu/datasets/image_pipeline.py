@@ -1,0 +1,339 @@
+"""Real-data input pipeline: datasets, augmentation, prefetch.
+
+Reference behavior being rebuilt (path unverified, SURVEY.md provenance):
+the reference's ImageNet example consumed real images with host-side
+preprocessing — random crop + horizontal flip + mean subtraction — fed
+from worker processes 〔examples/imagenet/train_imagenet.py〕.
+
+TPU-native design:
+
+* **Host does uint8 work, device does float work.**  Decode, crop and
+  flip happen on the host in uint8 (4× fewer bytes over PCIe/DCN than
+  f32); mean/std normalization is :func:`normalize_image`, one fused
+  device op at the head of the loss — XLA folds it into the first conv's
+  prologue.
+* **Prefetch hides the host.**  :class:`PrefetchIterator` wraps any
+  batch iterator: a producer thread pulls index batches and fans the
+  per-sample decode+augment out to a thread pool (PIL releases the GIL
+  in decode/resize), collating into a bounded queue ahead of the
+  consumer.  The training loop's ``next()`` is a queue pop, so input
+  work overlaps the device step like the reference's multiprocess
+  feeders did.
+* Epoch bookkeeping (``epoch`` / ``is_new_epoch`` / ``epoch_detail``)
+  is snapshotted WITH each produced batch and restored at consumption,
+  so look-ahead never skews trainer triggers.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+class ImageFolderDataset:
+    """``root/<class_name>/<image>`` tree, lazily decoded with PIL.
+
+    ``__getitem__`` returns ``(uint8 [H, W, 3], int32 label)``; classes are
+    the sorted subdirectory names.  ``resize`` (int) resizes the short side
+    before any augmentation (the usual decode-time downscale).
+    """
+
+    def __init__(self, root: str, resize: Optional[int] = None):
+        self.root = root
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not self.classes:
+            raise ValueError(f"no class subdirectories under {root!r}")
+        self.samples: list = []
+        for label, cls in enumerate(self.classes):
+            cdir = os.path.join(root, cls)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(_IMG_EXTS):
+                    self.samples.append((os.path.join(cdir, fn), label))
+        if not self.samples:
+            raise ValueError(f"no images found under {root!r}")
+        self.resize = resize
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        from PIL import Image
+
+        path, label = self.samples[i]
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            if self.resize:
+                w, h = im.size
+                s = self.resize / min(w, h)
+                if s != 1.0:
+                    im = im.resize((max(1, round(w * s)),
+                                    max(1, round(h * s))))
+            arr = np.asarray(im, dtype=np.uint8)
+        return arr, np.int32(label)
+
+
+class NpzImageDataset:
+    """npz/dict with image + label arrays (``x``/``y`` or
+    ``x_train``/``y_train``); images uint8 or float, NHWC."""
+
+    def __init__(self, path_or_arrays, x_key: Optional[str] = None,
+                 y_key: Optional[str] = None):
+        if isinstance(path_or_arrays, (str, os.PathLike)):
+            data = np.load(path_or_arrays)
+        else:
+            data = path_or_arrays
+        keys = set(getattr(data, "files", None) or data.keys())
+        xk = x_key or ("x" if "x" in keys else "x_train")
+        yk = y_key or ("y" if "y" in keys else "y_train")
+        if xk not in keys or yk not in keys:
+            raise KeyError(f"need {xk!r}/{yk!r} arrays, found {sorted(keys)}")
+        self.x = np.asarray(data[xk])
+        self.y = np.asarray(data[yk]).astype(np.int32)
+        if len(self.x) != len(self.y):
+            raise ValueError(f"x/y length mismatch: {len(self.x)} vs "
+                             f"{len(self.y)}")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+# ---------------------------------------------------------------------------
+# augmentation (host side, uint8 in -> uint8 out)
+# ---------------------------------------------------------------------------
+
+def random_crop(img: np.ndarray, size: int, rng: np.random.RandomState,
+                pad: int = 0) -> np.ndarray:
+    """Random ``size``×``size`` crop, optionally after zero-padding ``pad``
+    on each side (the CIFAR recipe)."""
+    if pad:
+        img = np.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+    h, w = img.shape[:2]
+    if h < size or w < size:
+        raise ValueError(f"image {h}x{w} smaller than crop {size}")
+    top = rng.randint(h - size + 1)
+    left = rng.randint(w - size + 1)
+    return img[top:top + size, left:left + size]
+
+
+def center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = max(0, (h - size) // 2)
+    left = max(0, (w - size) // 2)
+    return img[top:top + size, left:left + size]
+
+
+def random_flip(img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+    return img[:, ::-1] if rng.rand() < 0.5 else img
+
+
+def random_sized_crop(img: np.ndarray, size: int,
+                      rng: np.random.RandomState,
+                      scale: Tuple[float, float] = (0.3, 1.0),
+                      ratio: Tuple[float, float] = (3 / 4, 4 / 3)):
+    """Inception-style random-area crop resized to ``size``×``size``
+    (the reference era's GoogLeNet/ResNet train-time augmentation)."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target = area * rng.uniform(*scale)
+        ar = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target * ar)))
+        ch = int(round(np.sqrt(target / ar)))
+        if cw <= w and ch <= h:
+            top = rng.randint(h - ch + 1)
+            left = rng.randint(w - cw + 1)
+            crop = img[top:top + ch, left:left + cw]
+            break
+    else:
+        crop = center_crop(img, min(h, w))
+    if crop.shape[:2] != (size, size):
+        crop = np.asarray(
+            Image.fromarray(crop).resize((size, size)), dtype=np.uint8)
+    return crop
+
+
+class Augment:
+    """Composable train/eval transform: ``Augment(image_size, train=True)``.
+
+    Train: random-sized crop (or pad-and-crop when ``pad`` given, the
+    CIFAR recipe) + horizontal flip.  Eval: center crop.  Seeded per
+    instance; every sample draw advances the stream.
+    """
+
+    def __init__(self, image_size: int, train: bool = True,
+                 pad: Optional[int] = None, flip: bool = True,
+                 seed: int = 0):
+        self.image_size = image_size
+        self.train = train
+        self.pad = pad
+        self.flip = flip
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def __call__(self, sample):
+        img, label = sample
+        img = np.asarray(img)
+        with self._lock:  # RandomState is not thread-safe
+            seed = self._rng.randint(2 ** 31)
+        rng = np.random.RandomState(seed)
+        if self.train:
+            if self.pad is not None:
+                img = random_crop(img, self.image_size, rng, pad=self.pad)
+            elif img.shape[0] != self.image_size or \
+                    img.shape[1] != self.image_size:
+                img = random_sized_crop(img, self.image_size, rng)
+            if self.flip:
+                img = random_flip(img, rng)
+        else:
+            if img.shape[0] != self.image_size or \
+                    img.shape[1] != self.image_size:
+                img = center_crop(img, self.image_size)
+        return np.ascontiguousarray(img), label
+
+
+# ImageNet channel statistics (uint8 scale) — the reference subtracted a
+# mean image; per-channel mean/std is the modern equivalent.
+IMAGENET_MEAN = (123.675, 116.28, 103.53)
+IMAGENET_STD = (58.395, 57.12, 57.375)
+
+
+def normalize_image(x, mean: Sequence[float] = IMAGENET_MEAN,
+                    std: Sequence[float] = IMAGENET_STD, dtype=None):
+    """Device-side uint8 -> float normalize — call at the head of the loss
+    so the host ships uint8 and XLA fuses the cast into the first conv."""
+    import jax.numpy as jnp
+
+    dt = dtype or jnp.float32
+    m = jnp.asarray(mean, dt).reshape((1,) * (x.ndim - 1) + (-1,))
+    s = jnp.asarray(std, dt).reshape((1,) * (x.ndim - 1) + (-1,))
+    return (x.astype(dt) - m) / s
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+class PrefetchIterator:
+    """Wrap a batch iterator; decode/augment/collate ahead in threads.
+
+    ``inner`` yields batches of samples (what :class:`SerialIterator`
+    produces: a collated tuple OR a list of per-sample tuples — both are
+    handled).  ``transform`` is applied per SAMPLE in a thread pool.  Up
+    to ``prefetch`` finished batches wait in a bounded queue, so the
+    device step and the host input work overlap.
+
+    The iterator protocol (``next``, ``epoch``, ``is_new_epoch``,
+    ``epoch_detail``, ``iteration``) matches ``SerialIterator``; epoch
+    state is captured with each produced batch and restored when that
+    batch is CONSUMED, so trainer triggers fire at the right step even
+    with look-ahead.  Call :meth:`close` (or let the training process
+    exit — the threads are daemons) to shut down.
+    """
+
+    def __init__(self, inner, transform: Optional[Callable] = None,
+                 prefetch: int = 2, workers: int = 4):
+        self.inner = inner
+        self.transform = transform
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
+        self._pool = ThreadPoolExecutor(max_workers=max(1, workers))
+        self._stop = threading.Event()
+        self.epoch = getattr(inner, "epoch", 0)
+        self.is_new_epoch = False
+        self.iteration = 0
+        self._epoch_detail = float(self.epoch)
+        self._producer = threading.Thread(target=self._produce, daemon=True)
+        self._producer.start()
+
+    # -- producer side ------------------------------------------------------
+    def _prepare(self, batch):
+        if isinstance(batch, tuple):          # collated arrays -> per-sample
+            samples = list(zip(*batch))
+        else:
+            samples = list(batch)
+        if self.transform is not None:
+            samples = list(self._pool.map(self.transform, samples))
+        first = samples[0]
+        if isinstance(first, tuple):
+            return tuple(np.stack([s[i] for s in samples])
+                         for i in range(len(first)))
+        return np.stack(samples)
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self.inner.next()
+                except StopIteration:
+                    self._q.put(("stop", None, None))
+                    return
+                meta = (getattr(self.inner, "epoch", 0),
+                        getattr(self.inner, "is_new_epoch", False),
+                        getattr(self.inner, "epoch_detail", 0.0))
+                out = self._prepare(batch)
+                self._q.put(("batch", out, meta))
+        except Exception as e:  # surface worker errors at the consumer
+            self._q.put(("error", e, None))
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, payload, meta = self._q.get()
+        if kind == "stop":
+            raise StopIteration
+        if kind == "error":
+            self.close()
+            raise payload
+        self.epoch, self.is_new_epoch, self._epoch_detail = meta
+        self.iteration += 1
+        return payload
+
+    next = __next__
+
+    @property
+    def epoch_detail(self):
+        return self._epoch_detail
+
+    def reset(self):
+        raise NotImplementedError(
+            "PrefetchIterator cannot rewind its producer; create a new one")
+
+    def close(self):
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+__all__ = [
+    "Augment",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "ImageFolderDataset",
+    "NpzImageDataset",
+    "PrefetchIterator",
+    "center_crop",
+    "normalize_image",
+    "random_crop",
+    "random_flip",
+    "random_sized_crop",
+]

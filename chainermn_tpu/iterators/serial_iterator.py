@@ -10,11 +10,16 @@ import numpy as np
 
 class SerialIterator:
     def __init__(self, dataset, batch_size: int, *, repeat: bool = True,
-                 shuffle: bool = True, seed: Optional[int] = None):
+                 shuffle: bool = True, seed: Optional[int] = None,
+                 collate: bool = True):
         self.dataset = dataset
         self.batch_size = batch_size
         self._repeat = repeat
         self._shuffle = shuffle
+        # collate=False yields the raw example list — required for
+        # variable-size samples (e.g. undecoded/uncropped images) that a
+        # downstream PrefetchIterator transforms and stacks itself
+        self._collate = collate
         self._rng = np.random.RandomState(seed)
         self.epoch = 0
         self.iteration = 0
@@ -66,7 +71,7 @@ class SerialIterator:
             self._pos = end
         self.iteration += 1
         examples = [self.dataset[int(i)] for i in idx]
-        return _collate(examples)
+        return _collate(examples) if self._collate else examples
 
     next = __next__
 

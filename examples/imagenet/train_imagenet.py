@@ -28,7 +28,9 @@ import numpy as np
 import optax
 
 import chainermn_tpu
-from chainermn_tpu.datasets import TupleDataset
+from chainermn_tpu.datasets import (
+    Augment, ImageFolderDataset, NpzImageDataset, PrefetchIterator,
+    TupleDataset, normalize_image)
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models import (
     AlexNet, GoogLeNet, GoogLeNetBN, NIN, ResNet50)
@@ -72,6 +74,15 @@ def main():
                         help="synthetic dataset size (no --train-root)")
     parser.add_argument("--train-root", default=None,
                         help="npz with x_train/y_train/x_val/y_val arrays")
+    parser.add_argument("--data", default=None, metavar="DIR",
+                        help="ImageFolder root (DIR/<class>/<img>); images "
+                             "are decoded, augmented (random-sized crop + "
+                             "flip) and prefetched on the host, shipped "
+                             "uint8, normalized on device")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="prefetched batches (0 disables the loader "
+                             "thread)")
+    parser.add_argument("--loader-workers", type=int, default=4)
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--lr", type=float, default=0.1)
@@ -85,8 +96,6 @@ def main():
         allreduce_grad_dtype=args.allreduce_grad_dtype)
 
     model_cls, has_bn = ARCHS[args.arch]
-    model = model_cls(num_classes=args.n_classes,
-                      dtype=jnp.dtype(args.dtype))
 
     if comm.rank == 0:
         print("==========================================")
@@ -99,10 +108,19 @@ def main():
             print("Using double buffering (1-step-stale gradients)")
         print("==========================================")
 
-    if args.train_root:
-        with np.load(args.train_root) as d:
-            train = TupleDataset(d["x_train"].astype(np.float32),
-                                 d["y_train"].astype(np.int32))
+    augment = None   # n_classes may come from the data; model built after
+    if args.data:
+        # real images: decode at short-side 256-scale, augment per sample
+        train = ImageFolderDataset(
+            args.data, resize=max(args.image_size,
+                                  round(args.image_size * 256 / 224)))
+        args.n_classes = len(train.classes)
+        augment = Augment(args.image_size, train=True, seed=args.seed)
+    elif args.train_root:
+        train = NpzImageDataset(args.train_root)
+        if train.x.dtype == np.uint8 and \
+                train.x.shape[1] != args.image_size:
+            augment = Augment(args.image_size, train=True, seed=args.seed)
     else:
         train = make_synthetic_imagenet(
             args.train_size, args.image_size, args.n_classes, args.seed)
@@ -110,8 +128,20 @@ def main():
                                           seed=args.seed)
     # reference batchsize is per-rank(GPU); this host feeds its local devices
     local_bs = args.batchsize * comm.size // comm.host_size
+    # raw (uncollated) batches when a per-sample transform will run; the
+    # prefetch loop decodes/augments/collates ahead of the device step
     train_iter = SerialIterator(train, local_bs, shuffle=True,
-                                seed=args.seed)
+                                seed=args.seed, collate=augment is None)
+    if args.prefetch > 0:
+        train_iter = PrefetchIterator(train_iter, transform=augment,
+                                      prefetch=args.prefetch,
+                                      workers=args.loader_workers)
+    elif augment is not None:
+        raise SystemExit("--prefetch 0 requires collatable data "
+                         "(no --data folder / augmentation)")
+
+    model = model_cls(num_classes=args.n_classes,
+                      dtype=jnp.dtype(args.dtype))
 
     # Per-iteration dropout keys: convert_batch stamps every batch with the
     # global step; loss_fn folds (step, device index) into the seed so masks
@@ -140,6 +170,8 @@ def main():
 
         def loss_fn(p, state, batch):
             x, y, it = batch
+            if x.dtype == jnp.uint8:   # real-image path ships uint8
+                x = normalize_image(x)
             logits, mutated = model.apply(
                 {"params": p, "batch_stats": state}, x, train=True,
                 mutable=["batch_stats"],
@@ -156,6 +188,8 @@ def main():
     else:
         def loss_fn(p, batch):
             x, y, it = batch
+            if x.dtype == jnp.uint8:   # real-image path ships uint8
+                x = normalize_image(x)
             logits = model.apply(
                 {"params": p}, x, train=True,
                 rngs={"dropout": dropout_rng(comm, it)})

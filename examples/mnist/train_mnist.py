@@ -56,6 +56,9 @@ def main():
     parser.add_argument("--unit", "-u", type=int, default=1000)
     parser.add_argument("--out", "-o", default="result")
     parser.add_argument("--data", default=None, help="npz with MNIST arrays")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="prefetched training batches (0 disables the "
+                             "loader thread)")
     parser.add_argument("--double-buffering", action="store_true",
                         help="overlap gradient allreduce with compute "
                              "(1-step-stale gradients)")
@@ -109,6 +112,12 @@ def main():
     # and each host's iterator supplies its share
     local_bs = args.batchsize * comm.size // comm.host_size
     train_iter = SerialIterator(train, local_bs, shuffle=True, seed=args.seed)
+    if args.prefetch > 0:
+        # batch assembly overlaps the device step (the evaluation iterator
+        # stays plain — it must rewind every epoch)
+        from chainermn_tpu.datasets import PrefetchIterator
+        train_iter = PrefetchIterator(train_iter, prefetch=args.prefetch,
+                                      workers=2)
     test_iter = SerialIterator(test, local_bs, repeat=False, shuffle=False)
 
     updater = StandardUpdater(train_iter, step, params, opt_state, comm)
