@@ -48,6 +48,8 @@ _EXPORTS = {
     # micro-batch pipeline parallelism (beyond-reference extension)
     "pipeline_apply": "chainermn_tpu.parallel.pipeline",
     "make_pipeline_fn": "chainermn_tpu.parallel.pipeline",
+    "make_pipeline_train_fn": "chainermn_tpu.parallel.pipeline",
+    "pipeline_1f1b": "chainermn_tpu.parallel.pipeline",
     # fused Pallas kernels
     "flash_attention": "chainermn_tpu.ops.flash_attention",
     # tensor / expert parallelism (beyond-reference extensions)

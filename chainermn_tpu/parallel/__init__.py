@@ -22,6 +22,8 @@ from chainermn_tpu.parallel.sequence import (
 )
 from chainermn_tpu.parallel.pipeline import (
     make_pipeline_fn,
+    make_pipeline_train_fn,
+    pipeline_1f1b,
     pipeline_apply,
 )
 from chainermn_tpu.parallel.tensor import (
@@ -47,6 +49,8 @@ __all__ = [
     "attention",
     "init_topology",
     "make_pipeline_fn",
+    "make_pipeline_train_fn",
+    "pipeline_1f1b",
     "pipeline_apply",
     "ring_attention",
     "topology_from_mesh",

@@ -8,12 +8,22 @@ import jax
 def pvary(x, axes):
     """Mark ``x`` as varying over mesh ``axes`` inside shard_map.
 
-    ``jax.lax.pvary`` is deprecated in favor of ``jax.lax.pcast(..., to=
-    'varying')``; this shim targets whichever this jax version provides.
+    Idempotent (axes already in the value's vma are skipped — pcast
+    rejects varying→varying).  ``jax.lax.pvary`` is deprecated in favor
+    of ``jax.lax.pcast(..., to='varying')``; this shim targets whichever
+    this jax version provides.
     """
+    want = (axes,) if isinstance(axes, str) else tuple(axes)
+    try:
+        have = jax.typeof(x).vma
+        missing = tuple(a for a in want if a not in have)
+    except (AttributeError, TypeError):
+        missing = want
+    if not missing:
+        return x
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+        return jax.lax.pcast(x, missing, to="varying")
+    return jax.lax.pvary(x, missing)
 
 
 def axis_size(axis_name) -> int:
