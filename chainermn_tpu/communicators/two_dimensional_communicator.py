@@ -8,10 +8,20 @@ on thin inter-node links; maps directly onto the 2-D ICI torus here.
 
 Here, on a packed flat buffer: ``psum_scatter`` over ``intra`` (each chip in
 the slice owns 1/intra_size of the gradient), ``psum`` over ``inter`` of the
-owned shard, ``all_gather`` over ``intra``.  Every leg is the XLA collective
-native to its axis.
+owned shard, then the gather-back leg over ``intra``.
+
+The gather-back leg is expressed as a masked psum (each chip contributes its
+shard placed at its offset in a zero buffer) rather than ``all_gather``:
+the two are value-identical, but JAX's varying-axes type system types an
+``all_gather`` output as *varying* over the axis, which would poison the
+updated parameters' replicated out_spec in ``make_train_step`` — psum output
+is invariant by construction.  Cost on the ICI leg: ~2x the bytes of a true
+all-gather (ring allreduce vs ring gather); the decomposition's point — the
+DCN leg carries only 1/intra_size of the gradient — is unchanged, and ICI
+bandwidth is the cheap resource the trade spends.
 """
 
+import jax.numpy as jnp
 from jax import lax
 
 from chainermn_tpu.communicators import _packing
@@ -29,12 +39,19 @@ class TwoDimensionalCommunicator(MeshCommunicator):
         inter_axes = self._data_axes[:-1]
         intra_axis = self._data_axes[-1]
         intra_size = int(self._mesh.shape[intra_axis])
+        me = lax.axis_index(intra_axis)
         buffers, meta = _packing.pack(grads)
         out = []
         for buf in buffers:
             buf, pad = _packing.pad_to_multiple(buf, intra_size)
+            n = buf.shape[0]
             shard = lax.psum_scatter(buf, intra_axis, tiled=True)   # ICI leg 1
             shard = lax.psum(shard, inter_axes)                     # DCN leg
-            full = lax.all_gather(shard, intra_axis, tiled=True)    # ICI leg 2
-            out.append(full[:buf.shape[0] - pad] if pad else full)
+            # ICI leg 2: gather-back as a masked psum (invariant-typed;
+            # see module docstring)
+            placed = lax.dynamic_update_slice_in_dim(
+                jnp.zeros((n,), buf.dtype), shard,
+                me * (n // intra_size), 0)
+            full = lax.psum(placed, intra_axis)
+            out.append(full[:n - pad] if pad else full)
         return _packing.unpack(out, meta, scale=1.0 / self.size)

@@ -192,6 +192,19 @@ def make_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if isinstance(opt_state, _DoubleBufferState):
+            # Anchor the loss/aux reporting reductions AFTER the parameter
+            # update: XLA's all-reduce combiner otherwise merges them with
+            # the pending-gradient psum into ONE collective, which then
+            # cannot start until the loss (i.e. the whole forward) is ready
+            # — squandering the overlap the double buffer exists for.  The
+            # barrier makes merging a dependency cycle, so the gradient
+            # psum keeps zero data dependencies and is schedulable from
+            # program start.  (Found in the 8-device-mesh HLO; see
+            # docs/performance.md "Double-buffering overlap".)
+            anchor = jax.tree.leaves(params)[0]
+            loss, anchor = jax.lax.optimization_barrier((loss, anchor))
+            if aux is not None:
+                aux, anchor = jax.lax.optimization_barrier((aux, anchor))
             opt_state = opt_state._replace(
                 pending=jax.tree.map(lambda a: a[None], opt_state.pending))
         if with_model_state:

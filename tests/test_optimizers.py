@@ -59,6 +59,27 @@ class TestMultiNodeOptimizer:
         np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
 
 
+@pytest.mark.parametrize("flavor", [
+    "naive", "flat", "hierarchical", "two_dimensional", "non_cuda_aware",
+    "xla"])
+def test_train_step_compiles_for_every_flavor(flavor):
+    """Regression: the FULL train step (replicated params out_spec) must
+    compile and produce the mean-gradient update for every communicator
+    decomposition.  two_dimensional's all_gather leg once produced
+    vma-varying gradients that poisoned the replicated out_spec — caught
+    only when the whole step was jitted, not by collective-level tests."""
+    comm = chainermn_tpu.create_communicator(flavor, intra_size=4)
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(1.0), comm)
+    params = {"w": jnp.zeros((3,))}
+    opt_state = init_opt_state(comm, opt, params)
+    step = make_train_step(comm, quad_loss, opt, donate=False)
+    targets = jnp.arange(comm.size, dtype=jnp.float32).reshape(
+        comm.size, 1, 1) * jnp.ones((comm.size, 1, 3))
+    batch = (targets.reshape(comm.size, 3),)
+    params2, _, loss = step(params, opt_state, batch)
+    np.testing.assert_allclose(np.asarray(params2["w"]), 3.5, rtol=1e-5)
+
+
 class TestDoubleBuffering:
     def test_one_step_staleness_exact(self, comm):
         """The fork's signature semantics (SURVEY.md §3.4): update t applies
