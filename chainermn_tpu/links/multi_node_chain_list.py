@@ -230,6 +230,68 @@ class MultiNodeChainList:
 
     __call__ = apply
 
+    def traced(self):
+        """One-XLA-program composition (single-controller only).
+
+        The eager :meth:`apply` dispatches each stage on its own device
+        group — matching the reference's define-by-run MPMD shape, but
+        (a) giving XLA no cross-stage program to fuse/overlap and (b)
+        leaving (S−1)/S of the machine idle at any instant, since the
+        stages are sequential anyway.  On a single controller that
+        placement is an emulation, not a necessity — so this returns
+        ``fn(params_list, *inputs)``: the SAME composition as pure value
+        flow (send/recv edges become direct data dependencies) under one
+        ``jax.jit``, letting XLA fuse across stage boundaries and run
+        every stage data-parallel over the full machine.  Semantics and
+        gradients are identical to ``apply``; pass uncommitted (host or
+        replicated) parameters — per-group-committed arrays would pin
+        the program to conflicting device sets.
+
+        Cross-controller chains must stay on the eager ``apply`` (their
+        stage boundaries are real DCN transfers with host-side ordering).
+        """
+        if self._n_procs > 1:
+            raise ValueError(
+                "traced() is single-controller only; cross-controller "
+                "chains need the eager apply (DCN transfers are host-side)")
+        links = list(self._links)
+        entry_stages = [s for s, (_, rin, _) in enumerate(links)
+                        if rin is None]
+
+        @jax.jit
+        def fn(params_list, *inputs):
+            slots: dict = {}
+            outputs = []
+            for s, (mod, rank_in, rank_out) in enumerate(links):
+                received: List[Any] = []
+                if rank_in is None:
+                    if inputs:
+                        if len(entry_stages) == 1:
+                            received.extend(inputs)
+                        else:
+                            received.append(inputs[entry_stages.index(s)])
+                else:
+                    ranks = (rank_in if isinstance(rank_in, (list, tuple))
+                             else [rank_in])
+                    for r in ranks:
+                        received.append(slots[(r, s)].pop(0))
+                y = mod.apply(params_list[s], *received)
+                if rank_out is None:
+                    outputs.append(y)
+                else:
+                    ranks = (rank_out if isinstance(rank_out, (list, tuple))
+                             else [rank_out])
+                    for r in ranks:
+                        slots.setdefault((s, r), []).append(y)
+            leftovers = [k for k, q in slots.items() if q]
+            if leftovers:
+                raise RuntimeError(
+                    f"unconsumed sends on edges {leftovers}: some rank_out "
+                    "has no matching rank_in consumer in this chain list")
+            return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+        return fn
+
     def _pick_anchor(self, params_list, s: int):
         """Anchor pytree for a cross-process recv's backward: stage ``s``'s
         params if they contain an inexact leaf, else any local stage's.
