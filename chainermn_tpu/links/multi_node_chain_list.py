@@ -259,7 +259,8 @@ class MultiNodeChainList:
                         if rin is None]
 
         @jax.jit
-        def fn(params_list, *inputs):
+        def fn(params_list, *inputs, stage_inputs=None):
+            stage_inputs = stage_inputs or {}
             slots: dict = {}
             outputs = []
             for s, (mod, rank_in, rank_out) in enumerate(links):
@@ -275,6 +276,7 @@ class MultiNodeChainList:
                              else [rank_in])
                     for r in ranks:
                         received.append(slots[(r, s)].pop(0))
+                received.extend(stage_inputs.get(s, ()))
                 y = mod.apply(params_list[s], *received)
                 if rank_out is None:
                     outputs.append(y)

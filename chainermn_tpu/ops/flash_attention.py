@@ -96,18 +96,22 @@ def _shape_like(template, shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _unpack_rest(rest, has_seg, dropout_rate):
-    """Split a kernel's trailing refs into (qseg, kseg, seed, outputs) —
-    shared by all three kernels so the optional-input threading lives once."""
+def _unpack_rest(rest, has_seg, dropout_rate, has_offsets=False):
+    """Split a kernel's trailing refs into (qseg, kseg, seed, offs, outputs)
+    — shared by all three kernels so the optional-input threading lives
+    once."""
     idx = 0
-    qseg_ref = kseg_ref = seed_ref = None
+    qseg_ref = kseg_ref = seed_ref = offs_ref = None
     if has_seg:
         qseg_ref, kseg_ref = rest[0], rest[1]
         idx = 2
     if dropout_rate > 0.0:
         seed_ref = rest[idx]
         idx += 1
-    return qseg_ref, kseg_ref, seed_ref, rest[idx:]
+    if has_offsets:
+        offs_ref = rest[idx]
+        idx += 1
+    return qseg_ref, kseg_ref, seed_ref, offs_ref, rest[idx:]
 
 
 def _mask_tile(causal, q_pos, k_pos, seg_q, seg_k):
@@ -126,11 +130,12 @@ def _mask_tile(causal, q_pos, k_pos, seg_q, seg_k):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
-                has_seg, dropout_rate):
+                has_seg, dropout_rate, has_offsets):
     # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; optional qseg [1, BQ],
-    # kseg [1, T], seed [1, 1]; outputs o [1, BQ, D], lse [1, BQ].
-    qseg_ref, kseg_ref, seed_ref, (o_ref, lse_ref) = _unpack_rest(
-        rest, has_seg, dropout_rate)
+    # kseg [1, T], seed [1, 1], offs [1, 2]; outputs o [1, BQ, D],
+    # lse [1, BQ].
+    qseg_ref, kseg_ref, seed_ref, offs_ref, (o_ref, lse_ref) = _unpack_rest(
+        rest, has_seg, dropout_rate, has_offsets)
 
     q = q_ref[0]                                         # [BQ, D]
     t = k_ref.shape[1]
@@ -138,7 +143,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
     q_off = pl.program_id(1) * bq
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
-    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    # global position offsets (ring-attention blocks of a longer sequence)
+    goff_q = offs_ref[0, 0] if has_offsets else 0
+    goff_k = offs_ref[0, 1] if has_offsets else 0
+    q_pos = goff_q + q_off + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 0)
 
     def body(j, carry):
         acc, m, l = carry
@@ -148,7 +157,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
         # so results match it to tight tolerance
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
+        k_pos = goff_k + j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         seg_q = qseg_ref[0, 0] if has_seg else None
         seg_k = (kseg_ref[0, 0, pl.dslice(j * block_k, block_k)]
@@ -176,9 +185,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
 
     n_k = t // block_k
     if causal:
-        # K/V tiles strictly after this q tile's last row are fully masked;
-        # skip them (upper bound depends on the q tile -> dynamic).
-        n_k = jnp.minimum(n_k, (q_off + bq + block_k - 1) // block_k)
+        # K/V tiles whose first global row is past this q tile's last
+        # global row are fully masked; skip them.  The bound is traced
+        # either way (program_id, and offsets when present).
+        n_k = jnp.minimum(n_k, jnp.maximum(
+            0, (goff_q + q_off + bq - 1 - goff_k) // block_k + 1))
     d = q.shape[1]
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -191,8 +202,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
     lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
-def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
-             dropout_rate, interpret):
+def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
+             block_k, dropout_rate, interpret):
     b, t, h, d = q.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bq = min(block_q, t)
@@ -206,10 +217,12 @@ def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     qf, kf, vf = fold(q), fold(k), fold(v)
     has_seg = qseg is not None
+    has_offsets = offs is not None
 
     kern = functools.partial(_fwd_kernel, sm_scale=scale, causal=causal,
                              block_k=bk, has_seg=has_seg,
-                             dropout_rate=dropout_rate)
+                             dropout_rate=dropout_rate,
+                             has_offsets=has_offsets)
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     ins = [qf, kf, vf]
     in_specs = [
@@ -230,6 +243,9 @@ def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
     if dropout_rate > 0.0:
         ins.append(seed.reshape(1, 1))
         in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw))
+    if has_offsets:
+        ins.append(offs.reshape(1, 2))
+        in_specs.append(pl.BlockSpec((1, 2), lambda i, j: (0, 0), **kw))
     # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
     out_shape = [_shape_like(qf, (b * h, t, d), q.dtype),
@@ -251,11 +267,18 @@ def _forward(q, k, v, qseg, kseg, seed, causal, sm_scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
-                sm_scale, causal, block_q, has_seg, dropout_rate):
+                sm_scale, causal, block_q, has_seg, dropout_rate,
+                has_offsets, with_lse):
     # q_ref/g_ref: [1, T, D] (resident); k_ref/v_ref: [1, BK, D] tile;
-    # lse_ref/delta_ref: [1, T]; outputs dk/dv: [1, BK, D].
-    qseg_ref, kseg_ref, seed_ref, (dk_ref, dv_ref) = _unpack_rest(
-        rest, has_seg, dropout_rate)
+    # lse_ref/delta_ref: [1, 1, T]; optional glse [1, 1, T];
+    # outputs dk/dv: [1, BK, D].
+    qseg_ref, kseg_ref, seed_ref, offs_ref, outs = _unpack_rest(
+        rest, has_seg, dropout_rate, has_offsets)
+    if with_lse:
+        glse_ref, dk_ref, dv_ref = outs
+    else:
+        glse_ref = None
+        dk_ref, dv_ref = outs
 
     k = k_ref[0]                                          # [BK, D]
     v = v_ref[0]
@@ -266,7 +289,9 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
     k_off = pl.program_id(1) * bk
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
-    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    goff_q = offs_ref[0, 0] if has_offsets else 0
+    goff_k = offs_ref[0, 1] if has_offsets else 0
+    k_pos = goff_k + k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     seg_k = (kseg_ref[0, 0] if has_seg else None)
 
     def body(i, carry):
@@ -277,7 +302,8 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
         delta = delta_ref[0, 0, pl.dslice(i * bq, bq)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        q_pos = goff_q + i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
         seg_q = qseg_ref[0, 0, pl.dslice(i * bq, bq)] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])                     # normalized probs
@@ -297,13 +323,20 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
             a_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = a * (da - delta[:, None]) * sm_scale
+        if with_lse:
+            # cotangent flowing into the logsumexp output: d lse_i / d s_ij
+            # = a_ij (same a as above), in scaled-score space
+            glse = glse_ref[0, 0, pl.dslice(i * bq, bq)]
+            ds = ds + a * glse[:, None] * sm_scale
         dk = dk + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 
     n_q = t // bq
-    start = (k_off // bq) if causal else 0
+    # first q tile whose last global row reaches this k tile's first row
+    start = (jnp.clip((goff_k + k_off - goff_q) // bq, 0, n_q)
+             if causal else 0)
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
@@ -312,11 +345,18 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
 
 
 def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
-               sm_scale, causal, block_k, has_seg, dropout_rate):
+               sm_scale, causal, block_k, has_seg, dropout_rate,
+               has_offsets, with_lse):
     # q_ref/g_ref: [1, BQ, D] tile; k_ref/v_ref: [1, T, D] (resident);
-    # lse_ref/delta_ref: [1, BQ]; output dq: [1, BQ, D].
-    qseg_ref, kseg_ref, seed_ref, (dq_ref,) = _unpack_rest(
-        rest, has_seg, dropout_rate)
+    # lse_ref/delta_ref: [1, 1, BQ]; optional glse [1, 1, BQ];
+    # output dq: [1, BQ, D].
+    qseg_ref, kseg_ref, seed_ref, offs_ref, outs = _unpack_rest(
+        rest, has_seg, dropout_rate, has_offsets)
+    if with_lse:
+        glse_ref, dq_ref = outs
+    else:
+        glse_ref = None
+        (dq_ref,) = outs
 
     q = q_ref[0]
     g = g_ref[0]
@@ -329,15 +369,19 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
     q_off = pl.program_id(1) * bq
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
-    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    goff_q = offs_ref[0, 0] if has_offsets else 0
+    goff_k = offs_ref[0, 1] if has_offsets else 0
+    q_pos = goff_q + q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     seg_q = qseg_ref[0, 0] if has_seg else None
+    glse = glse_ref[0, 0] if with_lse else None
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :]
         v = v_ref[0, pl.dslice(j * bk, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        k_pos = goff_k + j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
         seg_k = kseg_ref[0, 0, pl.dslice(j * bk, bk)] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])
@@ -351,20 +395,24 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
         else:
             da = dp
         ds = a * (da - delta[:, None]) * sm_scale
+        if with_lse:
+            ds = ds + a * glse[:, None] * sm_scale
         return dq + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     n_k = t // bk
     if causal:
-        n_k = jnp.minimum(n_k, (q_off + bq + bk - 1) // bk)
+        n_k = jnp.minimum(n_k, jnp.maximum(
+            0, (goff_q + q_off + bq - 1 - goff_k) // bk + 1))
     dq0 = jnp.zeros((bq, d), jnp.float32)
     dq = jax.lax.fori_loop(0, n_k, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
-                     sm_scale, block_q, block_k, dropout_rate, interpret):
+def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
+                     causal, sm_scale, block_q, block_k, dropout_rate,
+                     interpret):
     b, t, h, d = q.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bq = min(block_q, t)
@@ -378,6 +426,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(
         -1, keepdims=True).swapaxes(1, 2)
     has_seg = qseg is not None
+    has_offsets = offs is not None
+    with_lse = g_lse is not None
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     shape = lambda s, dt: _shape_like(qf, s, dt)
     full = lambda: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw)
@@ -390,10 +440,14 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     seed_in = ([] if dropout_rate == 0.0 else [seed.reshape(1, 1)])
     seed_spec = ([] if dropout_rate == 0.0 else
                  [pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw)])
+    offs_in = ([offs.reshape(1, 2)] if has_offsets else [])
+    offs_spec = ([pl.BlockSpec((1, 2), lambda i, j: (0, 0), **kw)]
+                 if has_offsets else [])
 
     dkv_kern = functools.partial(
         _dkv_kernel, sm_scale=scale, causal=causal, block_q=bq,
-        has_seg=has_seg, dropout_rate=dropout_rate)
+        has_seg=has_seg, dropout_rate=dropout_rate,
+        has_offsets=has_offsets, with_lse=with_lse)
     ins = [qf, gf, kf, vf, lse, delta]
     in_specs = [full(), full(),
                 pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
@@ -404,6 +458,11 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
         in_specs += seg_specs((1, 1, t), (1, 1, bk))
     ins += seed_in
     in_specs += seed_spec
+    ins += offs_in
+    in_specs += offs_spec
+    if with_lse:
+        ins.append(g_lse)
+        in_specs.append(vec_full())
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(b * h, t // bk),
@@ -417,7 +476,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
 
     dq_kern = functools.partial(
         _dq_kernel, sm_scale=scale, causal=causal, block_k=bk,
-        has_seg=has_seg, dropout_rate=dropout_rate)
+        has_seg=has_seg, dropout_rate=dropout_rate,
+        has_offsets=has_offsets, with_lse=with_lse)
     ins = [qf, gf, kf, vf, lse, delta]
     in_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
                 pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
@@ -429,6 +489,12 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
         in_specs += seg_specs((1, 1, bq), (1, 1, t))
     ins += seed_in
     in_specs += seed_spec
+    ins += offs_in
+    in_specs += offs_spec
+    if with_lse:
+        ins.append(g_lse)
+        in_specs.append(pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j),
+                                     **kw))
     dq = pl.pallas_call(
         dq_kern,
         grid=(b * h, t // bq),
@@ -442,8 +508,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     return unfold(dq), unfold(dk), unfold(dv)
 
 
-def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
-                        sm_scale, block_k, dropout_rate):
+def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
+                        causal, sm_scale, block_k, dropout_rate):
     """Pure-XLA blockwise flash backward — the gradient-parity oracle.
 
     Identical math to the Pallas kernels (saved-lse softmax, the same
@@ -458,7 +524,10 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
     qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
     lseT = lse.reshape(b, h, t)  # lse arrives [B*H, 1, T]
-    q_pos = jnp.arange(t)
+    glseT = g_lse.reshape(b, h, t) if g_lse is not None else None
+    goff_q = offs[0] if offs is not None else 0
+    goff_k = offs[1] if offs is not None else 0
+    q_pos = goff_q + jnp.arange(t)
     bh_idx = jnp.arange(b * h).reshape(b, h, 1, 1)
     D = (gT * oT).sum(-1)                                  # [B, H, T]
     inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
@@ -466,8 +535,8 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     def tile_mask(j):
         mask = None
         if causal:
-            mask = (q_pos[:, None] >= (j * bk + jnp.arange(bk))[None, :]
-                    )[None, None]
+            mask = (q_pos[:, None] >=
+                    (goff_k + j * bk + jnp.arange(bk))[None, :])[None, None]
         if qseg is not None:
             kseg_j = jax.lax.dynamic_slice_in_dim(kseg, j * bk, bk, axis=1)
             m2 = (qseg[:, None, :, None] == kseg_j[:, None, None, :])
@@ -477,7 +546,7 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
     def keep(j):
         if dropout_rate == 0.0:
             return None
-        k_pos = (j * bk + jnp.arange(bk))[None, None, None, :]
+        k_pos = (goff_k + j * bk + jnp.arange(bk))[None, None, None, :]
         return _keep_mask(seed.astype(jnp.uint32), bh_idx,
                           q_pos[None, None, :, None], k_pos, dropout_rate)
 
@@ -500,6 +569,8 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
             da = dp
         dv_j = jnp.einsum("bhts,bhtd->bhsd", a_drop, gT)
         ds = a * (da - D[..., None]) * scale
+        if glseT is not None:
+            ds = ds + a * glseT[..., None] * scale
         dq = dq + jnp.einsum("bhts,bhsd->bhtd", ds, kb)
         dk_j = jnp.einsum("bhts,bhtd->bhsd", ds, qT)
         return dq, (dk_j, dv_j)
@@ -516,39 +587,53 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, g, causal,
 # custom_vjp plumbing + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, qseg, kseg, seed, dropout_rate, causal, sm_scale,
-           block_q, block_k, bwd_impl):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, qseg, kseg, seed, offs, dropout_rate, causal, sm_scale,
+           block_q, block_k, bwd_impl, with_lse):
     interpret = jax.default_backend() != "tpu"
-    out, _ = _forward(q, k, v, qseg, kseg, seed, causal, sm_scale,
-                      block_q, block_k, dropout_rate, interpret)
+    out, lse = _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale,
+                        block_q, block_k, dropout_rate, interpret)
+    if with_lse:
+        b, t, h, _ = q.shape
+        return out, lse.reshape(b, h, t)
     return out
 
 
-def _flash_fwd(q, k, v, qseg, kseg, seed, dropout_rate, causal, sm_scale,
-               block_q, block_k, bwd_impl):
+def _flash_fwd(q, k, v, qseg, kseg, seed, offs, dropout_rate, causal,
+               sm_scale, block_q, block_k, bwd_impl, with_lse):
     interpret = jax.default_backend() != "tpu"
-    out, lse = _forward(q, k, v, qseg, kseg, seed, causal, sm_scale,
+    out, lse = _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale,
                         block_q, block_k, dropout_rate, interpret)
-    return out, (q, k, v, out, lse, qseg, kseg, seed)
+    res = (q, k, v, out, lse, qseg, kseg, seed, offs)
+    if with_lse:
+        b, t, h, _ = q.shape
+        return (out, lse.reshape(b, h, t)), res
+    return out, res
 
 
 def _flash_bwd(dropout_rate, causal, sm_scale, block_q, block_k, bwd_impl,
-               res, g):
-    q, k, v, out, lse, qseg, kseg, seed = res
+               with_lse, res, g):
+    q, k, v, out, lse, qseg, kseg, seed, offs = res
+    if with_lse:
+        g, g_lse_bht = g
+        b, t, h, _ = q.shape
+        g_lse = g_lse_bht.reshape(b * h, 1, t).astype(jnp.float32)
+    else:
+        g_lse = None
     if bwd_impl == "pallas":
         interpret = jax.default_backend() != "tpu"
         dq, dk, dv = _pallas_backward(
-            q, k, v, out, lse, qseg, kseg, seed, g, causal, sm_scale,
-            block_q, block_k, dropout_rate, interpret)
+            q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse, causal,
+            sm_scale, block_q, block_k, dropout_rate, interpret)
     elif bwd_impl == "blockwise":
         dq, dk, dv = _blockwise_backward(
-            q, k, v, out, lse, qseg, kseg, seed, g, causal, sm_scale,
-            block_k, dropout_rate)
+            q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse, causal,
+            sm_scale, block_k, dropout_rate)
     else:
         raise ValueError(f"unknown bwd_impl {bwd_impl!r} "
                          "(expected 'pallas' or 'blockwise')")
-    return dq, dk, dv, None, None, None
+    return dq, dk, dv, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -559,6 +644,8 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K,
                     *, q_segment_ids=None, kv_segment_ids=None,
                     dropout_rate: float = 0.0, dropout_seed=None,
+                    q_offset=None, kv_offset=None,
+                    return_lse: bool = False,
                     bwd_impl: str = "pallas"):
     """Fused softmax attention: [B, T, H, D] q/k/v -> [B, T, H, D].
 
@@ -575,6 +662,15 @@ def flash_attention(q, k, v, causal: bool = False,
       padding).  Passing either defaults the other to zeros.
     * ``dropout_rate`` + ``dropout_seed`` — attention dropout; the seed
       is a traced uint32 scalar (vary it per training step).
+    * ``q_offset`` / ``kv_offset`` — global positions of the first local
+      row (traced int scalars); the causal mask and the dropout hash use
+      global positions, so blocks of a longer sequence (ring attention)
+      mask consistently.
+    * ``return_lse`` — also return the per-row logsumexp [B, H, T]
+      (float32; fully-masked rows hold the sentinel 1e30).  The lse is
+      DIFFERENTIABLE: its cotangent adds ``a_ij * g_lse_i`` to the score
+      gradients in both backward implementations, which is what lets
+      downstream logsumexp merges (ring attention) backprop exactly.
     * ``bwd_impl`` — "pallas" (default, fused backward kernels) or
       "blockwise" (pure-XLA oracle with identical math).
     """
@@ -594,9 +690,15 @@ def flash_attention(q, k, v, causal: bool = False,
         dropout_seed = jnp.asarray(dropout_seed, jnp.uint32)
     else:
         dropout_seed = None
+    if (q_offset is not None) or (kv_offset is not None):
+        offs = jnp.stack([
+            jnp.asarray(0 if q_offset is None else q_offset, jnp.int32),
+            jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32)])
+    else:
+        offs = None
     return _flash(q, k, v, q_segment_ids, kv_segment_ids, dropout_seed,
-                  dropout_rate, bool(causal), sm_scale, int(block_q),
-                  int(block_k), bwd_impl)
+                  offs, dropout_rate, bool(causal), sm_scale, int(block_q),
+                  int(block_k), bwd_impl, bool(return_lse))
 
 
 __all__ = ["flash_attention"]

@@ -70,7 +70,8 @@ def attention(q, k, v, *, causal: bool = False, sm_scale: Optional[float] = None
 
 
 def ring_attention(q, k, v, axis_name, *, causal: bool = False,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   attn_fn: Optional[Callable] = None):
     """Exact attention over a sequence sharded on mesh axis ``axis_name``.
 
     Per device: ``q``/``k``/``v`` are the local sequence block
@@ -81,7 +82,19 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
     to fp associativity) the single-device softmax.  The per-step body is
     rematerialized in the backward pass (``jax.checkpoint``) so the
     [T_local, T_local] probability tiles are never stored per step.
+
+    ``attn_fn``: an inner attention kernel with the
+    :func:`chainermn_tpu.ops.flash_attention` extended signature
+    (``q_offset``/``kv_offset``/``return_lse``).  When given, each
+    visiting K/V block is processed by the fused kernel (the [T_local,
+    T_local] score tile never reaches HBM) and the per-block (out, lse)
+    pairs are folded with the standard logsumexp merge — differentiable
+    because the kernel's lse output is (its cotangent feeds ``a·g_lse``
+    back into the score gradients).
     """
+    if attn_fn is not None:
+        return _ring_attention_kernel(q, k, v, axis_name, causal=causal,
+                                      sm_scale=sm_scale, attn_fn=attn_fn)
     size = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     t_local = q.shape[1]
@@ -127,6 +140,48 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
         jax.checkpoint(fold), (k, v, acc0, m0, l0), jnp.arange(size))
     out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _ring_attention_kernel(q, k, v, axis_name, *, causal, sm_scale, attn_fn):
+    """Ring attention with a fused per-block kernel (see ring_attention)."""
+    from chainermn_tpu.utils import pvary
+
+    size = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    sentinel = 1e29  # kernel marks fully-masked rows with lse ~ 1e30
+
+    def fold(carry, step):
+        k_blk, v_blk, o_run, lse_run = carry
+        src = (me - step) % size
+        o_blk, lse_blk = attn_fn(
+            q, k_blk, v_blk, causal=causal, sm_scale=sm_scale,
+            q_offset=me * t_local, kv_offset=src * t_local,
+            return_lse=True)
+        # sentinel rows attended nothing in this block -> merge weight 0
+        lse_b = jnp.where(lse_blk >= sentinel, -jnp.inf, lse_blk)
+        m = jnp.maximum(lse_run, lse_b)
+        finite = jnp.isfinite(m)
+        safe_m = jnp.where(finite, m, 0.0)
+        w_run = jnp.where(finite, jnp.exp(lse_run - safe_m), 0.0)
+        w_blk = jnp.where(finite, jnp.exp(lse_b - safe_m), 0.0)
+        denom = w_run + w_blk
+        safe_denom = jnp.where(denom == 0.0, 1.0, denom)
+        # weights arrive [B, H, T]; activations are [B, T, H, D]
+        tr = lambda w: w.transpose(0, 2, 1)[..., None]
+        o_new = (o_run * tr(w_run)
+                 + o_blk.astype(jnp.float32) * tr(w_blk)) / tr(safe_denom)
+        lse_new = jnp.where(finite, safe_m + jnp.log(safe_denom), -jnp.inf)
+        k_blk, v_blk = lax.ppermute(
+            (k_blk, v_blk), axis_name,
+            perm=[(i, (i + 1) % size) for i in range(size)])
+        return (k_blk, v_blk, o_new, lse_new), None
+
+    o0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), axis_name)
+    lse0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), axis_name)
+    (k, v, o_run, _), _ = lax.scan(
+        jax.checkpoint(fold), (k, v, o0, lse0), jnp.arange(size))
+    return o_run.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal: bool = False,

@@ -162,6 +162,90 @@ def test_dropout_grads_match_blockwise_oracle():
                                    err_msg=f"grad wrt {name}")
 
 
+def test_causal_offsets_match_unfused():
+    """q_offset/kv_offset reproduce attention()'s global-position causal
+    mask for blocks of a longer sequence."""
+    rng = np.random.RandomState(12)
+    mk = lambda t: jnp.asarray(rng.randn(1, t, 2, 32), jnp.float32) * 0.3
+    q, k, v = mk(128), mk(128), mk(128)
+    # q block sits at global rows 256.., kv block at 128..
+    got = flash_attention(q, k, v, True, q_offset=256, kv_offset=128)
+    want = attention(q, k, v, causal=True, q_offset=256, k_offset=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+    # kv strictly in the future -> fully masked -> zero output
+    got = flash_attention(q, k, v, True, q_offset=0, kv_offset=512)
+    np.testing.assert_allclose(np.asarray(got), 0.0)
+
+
+def test_return_lse_value_and_gradient():
+    """The lse output equals the dense logsumexp and is differentiable —
+    grads through (out, lse) match the pure-XLA computation."""
+    rng = np.random.RandomState(13)
+    mk = lambda: jnp.asarray(rng.randn(1, 256, 2, 32), jnp.float32) * 0.3
+    q, k, v = mk(), mk(), mk()
+    scale = 32 ** -0.5
+
+    out, lse = flash_attention(q, k, v, False, return_lse=True)
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    want_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss_flash(a, b, c, impl):
+        o, l = flash_attention(a, b, c, False, return_lse=True,
+                               bwd_impl=impl)
+        return (o ** 2).sum() + (l ** 2).sum()
+
+    def loss_ref(a, b, c):
+        ss = jnp.einsum("bthd,bshd->bhts", a, b) * scale
+        p = jax.nn.softmax(ss, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", p, c)
+        l = jax.scipy.special.logsumexp(ss, axis=-1)
+        return (o ** 2).sum() + (l ** 2).sum()
+
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for impl in ("pallas", "blockwise"):
+        got = jax.grad(lambda a, b, c: loss_flash(a, b, c, impl),
+                       argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"[{impl}] grad wrt {name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_with_flash_kernel(devices, causal):
+    """Ring attention folding fused-kernel (out, lse) blocks equals the
+    single-device reference, forward and backward."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from chainermn_tpu.parallel.sequence import ring_attention
+
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    rng = np.random.RandomState(14)
+    mk = lambda: jnp.asarray(rng.randn(1, 1024, 2, 32), jnp.float32) * 0.3
+    q, k, v = mk(), mk(), mk()
+
+    def ring(a, b, c):
+        return jax.shard_map(
+            lambda x, y, z: ring_attention(
+                x, y, z, axis_name="sp", causal=causal,
+                attn_fn=flash_attention),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False)(a, b, c)
+
+    got = jax.jit(ring)(q, k, v)
+    want = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+    g_got = jax.grad(lambda a: (ring(a, k, v) ** 2).sum())(q)
+    g_want = jax.grad(lambda a: (attention(a, k, v, causal=causal) ** 2
+                                 ).sum())(q)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_rejects_indivisible_sequence():
     rng = np.random.RandomState(3)
     # T <= block size runs as one tile (any T); T > block size must divide

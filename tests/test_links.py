@@ -125,6 +125,21 @@ class TestTraced:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-6)
 
+    def test_traced_supports_stage_inputs(self, comm):
+        """The seq2seq pattern: a stage fed extra local arrays works the
+        same traced as eager."""
+        m = MultiNodeChainList(comm)
+        m.add_link(StageA(), rank_in=None, rank_out=1)
+        m.add_link(TwoInputStage(), rank_in=0, rank_out=None)
+        x = jnp.ones((4, 12))
+        extra = jnp.full((4, 4), 10.0)
+        params = m.init(jax.random.key(0), x, stage_inputs={1: (extra,)})
+        host = jax.device_get(list(params))
+        y_traced = m.traced()(host, x, stage_inputs={1: (extra,)})
+        y_eager = m.apply(params, x, stage_inputs={1: (extra,)})
+        np.testing.assert_allclose(np.asarray(y_traced),
+                                   np.asarray(y_eager), rtol=1e-5)
+
     def test_traced_is_one_program(self, comm):
         """The traced path compiles to a single executable (stage count
         doesn't multiply dispatches)."""
