@@ -9,7 +9,8 @@ attention is pluggable between
   (:func:`chainermn_tpu.ops.flash_attention`), single-shard;
 * ``attention_impl="ring"`` — ring attention over a mesh axis
   (:func:`chainermn_tpu.parallel.sequence.ring_attention`) for sequences
-  sharded across chips;
+  sharded across chips; ``"ring_flash"`` runs each visiting block through
+  the fused Pallas kernel (logsumexp-merged);
 * ``attention_impl="ulysses"`` — all-to-all head/sequence exchange;
 * ``attention_impl="xla"`` — the unfused reference math.
 
@@ -34,6 +35,12 @@ def _attend(impl: str, axis_name, q, k, v, causal: bool):
         from chainermn_tpu.parallel.sequence import ring_attention
 
         return ring_attention(q, k, v, axis_name, causal=causal)
+    if impl == "ring_flash":
+        from chainermn_tpu.ops.flash_attention import flash_attention
+        from chainermn_tpu.parallel.sequence import ring_attention
+
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              attn_fn=flash_attention)
     if impl == "ulysses":
         from chainermn_tpu.parallel.sequence import ulysses_attention
 
@@ -43,7 +50,8 @@ def _attend(impl: str, axis_name, q, k, v, causal: bool):
 
         return attention(q, k, v, causal=causal)
     raise ValueError(
-        f"attention_impl must be flash|ring|ulysses|xla, got {impl!r}")
+        f"attention_impl must be flash|ring|ring_flash|ulysses|xla, "
+        f"got {impl!r}")
 
 
 class Block(nn.Module):

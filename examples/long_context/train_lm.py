@@ -42,7 +42,7 @@ def make_motif_task(n, seq_len, vocab, motif_len=16, seed=0):
 def main():
     p = argparse.ArgumentParser(description="chainermn_tpu long-context LM")
     p.add_argument("--attention", default="ring",
-                   choices=["ring", "ulysses", "flash", "xla"])
+                   choices=["ring", "ring_flash", "ulysses", "flash", "xla"])
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--batchsize", "-b", type=int, default=4)
     p.add_argument("--steps", type=int, default=40)
@@ -55,7 +55,7 @@ def main():
     args = p.parse_args()
 
     devices = jax.devices()
-    seq_parallel = args.attention in ("ring", "ulysses")
+    seq_parallel = args.attention in ("ring", "ring_flash", "ulysses")
     n_sp = len(devices) if seq_parallel else 1
     if args.seq_len % max(n_sp, 1):
         p.error(f"--seq-len must be divisible by {n_sp} devices")
@@ -99,9 +99,14 @@ def main():
                 count = jax.lax.psum(mask.sum(), "sp")
                 return total / count
 
+            # check_vma=False: the Pallas interpret-mode interpreter (CPU
+            # path of --attention ring_flash/flash) trips a dynamic_slice
+            # vma check inside shard_map; on TPU the kernel is compiled and
+            # no check is skipped.
             return jax.shard_map(body, mesh=mesh,
                                  in_specs=(P(), P(None, "sp")),
-                                 out_specs=P())(p_, tk)
+                                 out_specs=P(),
+                                 check_vma=False)(p_, tk)
         toks = jax.device_put(toks, NamedSharding(mesh, P(None, "sp")))
     else:
         def loss_fn(p_, tk):

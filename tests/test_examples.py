@@ -70,6 +70,16 @@ def test_long_context_ring_attention():
 
 
 @pytest.mark.slow
+def test_long_context_ring_flash():
+    """Sequence-sharded LM with the fused per-block kernel (interpret mode
+    on CPU; the compiled path is covered on TPU)."""
+    out = _run("long_context/train_lm.py",
+               "--attention", "ring_flash", "--seq-len", "256", "--steps",
+               "4", "--batchsize", "2", "--d-model", "64", "--layers", "1")
+    assert "done in" in out
+
+
+@pytest.mark.slow
 def test_moe_lm_trains_balanced():
     """Top-2 expert-parallel LM smoke: converges, reports routing stats,
     and no expert hoards the tokens during training.  (Aux-loss *efficacy*
