@@ -3,6 +3,7 @@ from chainermn_tpu.datasets.image_pipeline import (
     ImageFolderDataset,
     NpzImageDataset,
     PrefetchIterator,
+    TransformDataset,
     normalize_image,
 )
 from chainermn_tpu.datasets.scatter_dataset import (
@@ -19,6 +20,7 @@ __all__ = [
     "NpzImageDataset",
     "PrefetchIterator",
     "SubDataset",
+    "TransformDataset",
     "TupleDataset",
     "normalize_image",
     "scatter_dataset",

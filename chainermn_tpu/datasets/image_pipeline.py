@@ -223,6 +223,23 @@ def normalize_image(x, mean: Sequence[float] = IMAGENET_MEAN,
     return (x.astype(dt) - m) / s
 
 
+class TransformDataset:
+    """Apply a per-sample transform at access time (``dataset[i] ->
+    transform(dataset[i])``) — the collation-friendly shape for EVAL
+    iterators, which must rewind every epoch and therefore cannot sit
+    behind a :class:`PrefetchIterator` (no reset)."""
+
+    def __init__(self, dataset, transform: Callable):
+        self.dataset = dataset
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, i):
+        return self.transform(self.dataset[i])
+
+
 # ---------------------------------------------------------------------------
 # prefetch
 # ---------------------------------------------------------------------------
@@ -331,6 +348,7 @@ __all__ = [
     "ImageFolderDataset",
     "NpzImageDataset",
     "PrefetchIterator",
+    "TransformDataset",
     "center_crop",
     "normalize_image",
     "random_crop",

@@ -25,13 +25,33 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 
-def make_eval_fn(communicator, metrics_fn: Callable):
+def make_eval_fn(communicator, metrics_fn: Callable,
+                 with_model_state: bool = False):
     """Jitted SPMD evaluation step.
 
     ``metrics_fn(params, local_batch) -> dict of scalars`` runs per device on
     its batch shard; the returned dict is psum-averaged across the mesh.
+
+    ``with_model_state=True`` adds a device-local mutable-state slot
+    (flax ``batch_stats`` — stacked [size, ...] like the training step's,
+    see ``init_model_state``): ``metrics_fn(params, state, batch)``; each
+    device evaluates with ITS running statistics, the reference's
+    local-BN posture (sync beforehand with ``AllreducePersistent`` when a
+    globally-consistent eval is wanted).
     """
     comm = communicator
+
+    if with_model_state:
+        def eval_step(params, state, batch):
+            state = jax.tree.map(lambda a: a.squeeze(0), state)
+            m = metrics_fn(params, state, batch)
+            return comm.allreduce(m, "mean")
+
+        mapped = jax.shard_map(
+            eval_step, mesh=comm.mesh,
+            in_specs=(P(), P(comm.data_axes), P(comm.data_axes)),
+            out_specs=P())
+        return jax.jit(mapped)
 
     def eval_step(params, batch):
         m = metrics_fn(params, batch)

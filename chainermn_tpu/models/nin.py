@@ -31,6 +31,15 @@ class NIN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # stride-4 stem + three stride-2 valid pools: below ~48px the last
+        # stack's spatial dims reach zero and the global mean silently
+        # yields NaN logits — fail loudly instead
+        if min(x.shape[1], x.shape[2]) < 48:
+            raise ValueError(
+                f"NIN needs inputs of at least 48x48 (got "
+                f"{x.shape[1]}x{x.shape[2]}); smaller images collapse to "
+                "an empty feature map under its stride-4 stem + three "
+                "pools and the global average becomes NaN")
         x = x.astype(self.dtype)
         x = self._mlpconv(x, 96, (11, 11), (4, 4))
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

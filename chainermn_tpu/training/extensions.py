@@ -108,13 +108,19 @@ class Evaluator:
     name = "validation"
 
     def __init__(self, iterator, eval_fn: Callable, comm,
-                 prefix: str = "validation"):
+                 prefix: str = "validation",
+                 state_getter: Optional[Callable] = None):
         self.iterator = iterator
         self.eval_fn = eval_fn
         self.comm = comm
         self.prefix = prefix
+        # For stateful models (BatchNorm running stats): pulls the CURRENT
+        # model state from the trainer at evaluation time, and eval_fn
+        # becomes eval_fn(params, state, batch) — pair with
+        # make_eval_fn(..., with_model_state=True).
+        self.state_getter = state_getter
 
-    def evaluate(self, params) -> dict:
+    def evaluate(self, params, state=None) -> dict:
         from chainermn_tpu.training.trainer import put_global_batch
 
         totals: dict = {}
@@ -124,14 +130,19 @@ class Evaluator:
             # wrap-pad the final partial batch so its leading dim divides the
             # device count (same equal-length policy as scatter_dataset)
             batch = put_global_batch(self.comm, batch, pad_to_multiple=True)
-            metrics = self.eval_fn(params, batch)
+            if state is not None:
+                metrics = self.eval_fn(params, state, batch)
+            else:
+                metrics = self.eval_fn(params, batch)
             for k, v in metrics.items():
                 totals[k] = totals.get(k, 0.0) + _to_float(v)
             count += 1
         return {k: v / max(count, 1) for k, v in totals.items()}
 
     def __call__(self, trainer):
-        result = self.evaluate(trainer.updater.params)
+        state = (self.state_getter(trainer)
+                 if self.state_getter is not None else None)
+        result = self.evaluate(trainer.updater.params, state)
         trainer.observation.update(
             {f"{self.prefix}/{k}": v for k, v in result.items()})
 

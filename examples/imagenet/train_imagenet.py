@@ -30,7 +30,9 @@ import optax
 import chainermn_tpu
 from chainermn_tpu.datasets import (
     Augment, ImageFolderDataset, NpzImageDataset, PrefetchIterator,
-    TupleDataset, normalize_image)
+    TransformDataset, TupleDataset, normalize_image)
+from chainermn_tpu.extensions import (
+    create_multi_node_evaluator, make_eval_fn)
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models import (
     AlexNet, GoogLeNet, GoogLeNetBN, NIN, ResNet50)
@@ -83,6 +85,12 @@ def main():
                         help="prefetched batches (0 disables the loader "
                              "thread)")
     parser.add_argument("--loader-workers", type=int, default=4)
+    parser.add_argument("--val-data", default=None, metavar="DIR",
+                        help="ImageFolder root for validation (center-crop "
+                             "eval transform; metrics aggregated across the "
+                             "mesh and hosts every epoch)")
+    parser.add_argument("--val-size", type=int, default=512,
+                        help="synthetic validation set size (no --val-data)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--lr", type=float, default=0.1)
@@ -139,6 +147,21 @@ def main():
     elif augment is not None:
         raise SystemExit("--prefetch 0 requires collatable data "
                          "(no --data folder / augmentation)")
+
+    # validation set: real folder when given, else a held-out synthetic set
+    if args.val_data:
+        val_ds = ImageFolderDataset(
+            args.val_data, resize=max(args.image_size,
+                                      round(args.image_size * 256 / 224)))
+        val = TransformDataset(val_ds, Augment(args.image_size, train=False))
+    elif not args.data and not args.train_root:
+        val = make_synthetic_imagenet(
+            args.val_size, args.image_size, args.n_classes, args.seed + 1)
+    else:
+        val = None
+    if val is not None:
+        val = chainermn_tpu.scatter_dataset(val, comm, shuffle=False)
+        val_iter = SerialIterator(val, local_bs, repeat=False, shuffle=False)
 
     model = model_cls(num_classes=args.n_classes,
                       dtype=jnp.dtype(args.dtype))
@@ -207,11 +230,40 @@ def main():
         trainer.extend(chainermn_tpu.AllreducePersistent(
             comm, lambda t: t.updater.model_state,
             lambda t, s: setattr(t.updater, "model_state", s)))
+
+    if val is not None:
+        def val_metrics(p, *state_and_batch):
+            if has_bn:
+                state, batch = state_and_batch
+            else:
+                (batch,) = state_and_batch
+            x, y = batch
+            if x.dtype == jnp.uint8:
+                x = normalize_image(x)
+            if has_bn:
+                logits = model.apply(
+                    {"params": p, "batch_stats": state}, x, train=False)
+            else:
+                logits = model.apply({"params": p}, x, train=False)
+            return {
+                "loss": optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean(),
+                "accuracy": (logits.argmax(-1) == y).astype(
+                    jnp.float32).mean(),
+            }
+
+        evaluator = extensions.Evaluator(
+            val_iter, make_eval_fn(comm, val_metrics,
+                                   with_model_state=has_bn), comm,
+            state_getter=(lambda t: t.updater.model_state)
+            if has_bn else None)
+        evaluator = create_multi_node_evaluator(evaluator, comm)
+        trainer.extend(evaluator, trigger=(1, "epoch"))
     if comm.rank == 0:
         trainer.extend(extensions.LogReport(trigger=(1, "epoch")))
         trainer.extend(extensions.PrintReport(
             ["epoch", "iteration", "main/loss", "main/accuracy",
-             "elapsed_time"]))
+             "validation/loss", "validation/accuracy", "elapsed_time"]))
     trainer.run()
 
 

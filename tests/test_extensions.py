@@ -53,6 +53,32 @@ class TestMultiNodeEvaluator:
         assert isinstance(ev, Ev)
 
 
+class TestStatefulEvalFn:
+    def test_eval_with_model_state_uses_per_device_stats(self, comm):
+        """make_eval_fn(with_model_state=True): each device evaluates with
+        ITS slice of the stacked state (the local-BN posture), metrics
+        mesh-averaged."""
+        from chainermn_tpu.extensions import make_eval_fn
+
+        n = comm.size
+        # state: per-device offset 0..7 (stacked [size, 1])
+        state = {"off": jnp.arange(n, dtype=jnp.float32).reshape(n, 1)}
+        state = jax.device_put(
+            state, jax.sharding.NamedSharding(
+                comm.mesh, jax.sharding.PartitionSpec(comm.data_axes)))
+
+        def metrics(params, st, batch):
+            (x,) = batch
+            # device r's metric = params + its state offset + its shard mean
+            return {"m": params + st["off"][0] + x.mean()}
+
+        fn = make_eval_fn(comm, metrics, with_model_state=True)
+        x = jnp.zeros((n, 2))
+        out = fn(jnp.asarray(1.0), state, (x,))
+        # mean over devices of (1 + r + 0) = 1 + mean(0..7) = 4.5
+        np.testing.assert_allclose(float(out["m"]), 1.0 + (n - 1) / 2)
+
+
 class TestCheckpointer:
     def make_state(self):
         return {"params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},

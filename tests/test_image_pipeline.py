@@ -200,8 +200,9 @@ def test_imagenet_example_with_image_folder(image_tree, tmp_path):
         [sys.executable,
          os.path.join(repo, "examples/imagenet/train_imagenet.py"),
          "--arch", "nin", "--epoch", "2", "--batchsize", "2",
-         "--image-size", "32", "--dtype", "float32",
-         "--data", str(image_tree), "--out", str(tmp_path)],
+         "--image-size", "64", "--dtype", "float32", "--lr", "0.01",
+         "--data", str(image_tree), "--val-data", str(image_tree),
+         "--out", str(tmp_path)],
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}")
