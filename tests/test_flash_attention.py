@@ -276,3 +276,28 @@ def test_as_ulysses_inner_kernel(devices):
     want = attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-4, atol=3e-4)
+
+
+def test_asymmetric_blocks_with_offsets():
+    """block_q != block_k together with offsets: the tile-skip bounds must
+    stay exact (regression for the offset-aware causal trim)."""
+    rng = np.random.RandomState(15)
+    mk = lambda: jnp.asarray(rng.randn(1, 512, 2, 32), jnp.float32) * 0.3
+    q, k, v = mk(), mk(), mk()
+    got = flash_attention(q, k, v, True, None, 128, 64,
+                          q_offset=512, kv_offset=0)
+    want = attention(q, k, v, causal=True, q_offset=512, k_offset=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+    def loss(a):
+        return (flash_attention(a, k, v, True, None, 64, 128,
+                                q_offset=256, kv_offset=256) ** 2).sum()
+
+    def loss_ref(a):
+        return (attention(a, k, v, causal=True, q_offset=256,
+                          k_offset=256) ** 2).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss)(q)), np.asarray(jax.grad(loss_ref)(q)),
+        rtol=2e-3, atol=2e-3)

@@ -207,3 +207,24 @@ def test_imagenet_example_with_image_folder(image_tree, tmp_path):
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}")
     assert "loss" in proc.stdout.lower() or "epoch" in proc.stdout.lower()
+
+
+def test_prefetch_close_mid_production():
+    """close() while the producer is mid-stream neither hangs nor leaks an
+    exception into the consumer."""
+    import time
+
+    from chainermn_tpu.datasets import TupleDataset
+
+    ds = TupleDataset(np.arange(64, dtype=np.float32)[:, None],
+                      np.arange(64, dtype=np.int32))
+
+    def slow(sample):
+        time.sleep(0.01)
+        return sample
+
+    pre = PrefetchIterator(
+        SerialIterator(ds, 8, shuffle=False, collate=False),
+        transform=slow, prefetch=2, workers=2)
+    pre.next()
+    pre.close()  # producer may be mid-batch; must return promptly
