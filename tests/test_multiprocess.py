@@ -97,3 +97,66 @@ def test_two_process_control_plane(tmp_path, force_py):
     assert set(all_idx) == set(range(10))
     # same seed => both processes agreed on the same permutation
     assert results[0]["shard"] != list(range(5))  # actually shuffled (seed 7)
+
+
+_TREE_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import numpy as np
+from chainermn_tpu.runtime.control_plane import get_control_plane
+
+cp = get_control_plane()
+rank, size = cp.rank, cp.size
+out = {}
+# binomial-tree collectives over REAL sockets, non-power-of-two world,
+# non-zero root, structural + custom ops
+out["bcast"] = cp.bcast_obj([rank, "payload"] if rank == 1 else None, root=1)
+out["gather"] = cp.gather_obj(rank * 10, root=1)
+out["scatter"] = cp.scatter_obj(
+    [f"item{i}" for i in range(size)] if rank == 1 else None, root=1)
+out["prod"] = cp.allreduce_obj(rank + 2, op="prod")
+out["maxdict"] = cp.allreduce_obj({"a": rank, "b": [float(rank)]}, op="max")
+out["union"] = sorted(cp.allreduce_obj({rank}, op=lambda a, b: a | b))
+arr = cp.allreduce_obj(np.full(3, rank + 1.0))
+out["arrsum"] = [float(v) for v in arr]
+cp.barrier()
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_three_process_tree_collectives(tmp_path):
+    """Binomial-tree object collectives across 3 REAL processes (odd world,
+    root != 0, custom/structural reduce ops, ndarray payloads)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n = 3
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update({
+            "CHAINERMN_TPU_COORDINATOR": coord,
+            "CHAINERMN_TPU_NUM_PROCESSES": str(n),
+            "CHAINERMN_TPU_PROCESS_ID": str(r),
+            "CHAINERMN_TPU_REPO": repo,
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TREE_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    for r, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {r} failed:\n{stderr}\n{stdout}"
+        line = [l for l in stdout.splitlines() if l.startswith("RESULT ")]
+        assert line, stdout
+        results[r] = json.loads(line[0][len("RESULT "):])
+
+    for r in range(n):
+        assert results[r]["bcast"] == [1, "payload"]
+        assert results[r]["scatter"] == f"item{r}"
+        assert results[r]["prod"] == 2 * 3 * 4
+        assert results[r]["maxdict"] == {"a": 2, "b": [2.0]}
+        assert results[r]["union"] == [0, 1, 2]
+        assert results[r]["arrsum"] == [6.0, 6.0, 6.0]
+    assert results[1]["gather"] == [0, 10, 20]
+    assert results[0]["gather"] is None and results[2]["gather"] is None
