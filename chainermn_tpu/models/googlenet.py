@@ -5,10 +5,11 @@ Reference being rebuilt (path unverified, SURVEY.md provenance):
 〔examples/imagenet/models/googlenetbn.py〕 — the two Inception
 architectures in the reference's ImageNet example.  The BN variant follows
 the inception-BN recipe (BN after every conv, 3x3 factorization of the 5x5
-tower); the plain variant matches Szegedy et al.'s v1 towers.  Auxiliary
-classifier heads are omitted (the reference example trains with the main
-head's loss; the aux heads exist upstream for the paper recipe but are not
-needed for throughput or convergence parity at this scale).
+tower); the plain variant matches Szegedy et al.'s v1 towers.  With
+``aux_heads=True`` the two auxiliary classifiers (after 4a and 4d) are
+built and returned during training — the reference example's recipe sums
+``loss1*0.3 + loss2*0.3 + loss3`` 〔examples/imagenet/models/googlenet.py〕;
+pass ``--aux-loss`` to ``train_imagenet.py`` for that objective.
 
 NHWC / bf16-capable.  ``GoogLeNetBN`` carries ``batch_stats`` (local-BN,
 same semantics as :mod:`.resnet`); plain ``GoogLeNet`` does not.
@@ -75,11 +76,37 @@ _BLOCKS = {
 }
 
 
+class _AuxHead(nn.Module):
+    """Auxiliary classifier (Szegedy et al. §5): 5x5/3 avgpool -> 1x1 conv
+    128 -> dense 1024 -> dropout 0.7 -> classes."""
+
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # 5x5/3 valid pool assumes the 224-px feature map (14x14 -> 4x4);
+        # clamp the window so small inputs (tests, tiny images) still
+        # produce a non-empty map instead of a zero-size Dense input
+        win = (min(5, x.shape[1]), min(5, x.shape[2]))
+        x = nn.avg_pool(x, win, strides=(3, 3))
+        x = nn.relu(nn.Conv(128, (1, 1), dtype=self.dtype,
+                            param_dtype=jnp.float32)(x))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(1024, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
 class GoogLeNet(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.float32
     use_bn: bool = False
     dropout_rate: float = 0.4
+    aux_heads: bool = False   # return (logits, (aux1, aux2)) when training
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -104,9 +131,13 @@ class GoogLeNet(nn.Module):
             x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
                                dtype=self.dtype, name=f"inc{name}")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        aux = []
         for name in ("4a", "4b", "4c", "4d", "4e"):
             x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
                                dtype=self.dtype, name=f"inc{name}")(x, train)
+            if self.aux_heads and name in ("4a", "4d"):
+                aux.append(_AuxHead(self.num_classes, self.dtype,
+                                    name=f"aux{name}")(x, train))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for name in ("5a", "5b"):
             x = InceptionBlock(*_BLOCKS[name], use_bn=self.use_bn,
@@ -115,7 +146,10 @@ class GoogLeNet(nn.Module):
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)
-        return x.astype(jnp.float32)
+        logits = x.astype(jnp.float32)
+        if self.aux_heads and train:
+            return logits, tuple(aux)
+        return logits
 
 
 GoogLeNetBN = partial(GoogLeNet, use_bn=True)

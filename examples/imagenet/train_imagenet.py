@@ -91,6 +91,10 @@ def main():
                              "mesh and hosts every epoch)")
     parser.add_argument("--val-size", type=int, default=512,
                         help="synthetic validation set size (no --val-data)")
+    parser.add_argument("--aux-loss", action="store_true",
+                        help="googlenet/googlenetbn only: train with the "
+                             "auxiliary classifier heads (loss1*0.3 + "
+                             "loss2*0.3 + loss3, the reference recipe)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--lr", type=float, default=0.1)
@@ -163,8 +167,13 @@ def main():
         val = chainermn_tpu.scatter_dataset(val, comm, shuffle=False)
         val_iter = SerialIterator(val, local_bs, repeat=False, shuffle=False)
 
+    model_kwargs = {}
+    if args.aux_loss:
+        if args.arch not in ("googlenet", "googlenetbn"):
+            parser.error("--aux-loss only applies to googlenet/googlenetbn")
+        model_kwargs["aux_heads"] = True
     model = model_cls(num_classes=args.n_classes,
-                      dtype=jnp.dtype(args.dtype))
+                      dtype=jnp.dtype(args.dtype), **model_kwargs)
 
     # Per-iteration dropout keys: convert_batch stamps every batch with the
     # global step; loss_fn folds (step, device index) into the seed so masks
@@ -181,7 +190,10 @@ def main():
         return jax.random.fold_in(rng, comm.axis_index())
 
     x0 = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
-    variables = model.init(jax.random.key(args.seed), x0, train=False)
+    # init with train=True so train-only submodules (aux heads) get params
+    variables = model.init(
+        {"params": jax.random.key(args.seed),
+         "dropout": jax.random.key(args.seed + 1)}, x0, train=True)
     params = comm.bcast_data(variables["params"])
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.sgd(args.lr, momentum=0.9), comm,
@@ -195,12 +207,14 @@ def main():
             x, y, it = batch
             if x.dtype == jnp.uint8:   # real-image path ships uint8
                 x = normalize_image(x)
-            logits, mutated = model.apply(
+            out, mutated = model.apply(
                 {"params": p, "batch_stats": state}, x, train=True,
                 mutable=["batch_stats"],
                 rngs={"dropout": dropout_rng(comm, it)})
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean()
+            logits, aux = out if args.aux_loss else (out, ())
+            ce = lambda lg: optax.softmax_cross_entropy_with_integer_labels(
+                lg, y).mean()
+            loss = ce(logits) + 0.3 * sum(ce(a) for a in aux)
             acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
             return loss, (mutated["batch_stats"], {"accuracy": acc})
 
@@ -213,11 +227,13 @@ def main():
             x, y, it = batch
             if x.dtype == jnp.uint8:   # real-image path ships uint8
                 x = normalize_image(x)
-            logits = model.apply(
+            out = model.apply(
                 {"params": p}, x, train=True,
                 rngs={"dropout": dropout_rng(comm, it)})
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean()
+            logits, aux = out if args.aux_loss else (out, ())
+            ce = lambda lg: optax.softmax_cross_entropy_with_integer_labels(
+                lg, y).mean()
+            loss = ce(logits) + 0.3 * sum(ce(a) for a in aux)
             acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
             return loss, {"accuracy": acc}
 
