@@ -15,6 +15,12 @@ iteration, measured end to end.
 
 On CPU (no TPU attached) a reduced shape keeps the smoke run short; the
 JSON line is still emitted so the harness contract holds everywhere.
+
+The whole measurement is wrapped in a bounded retry (default 3 attempts):
+the tunneled TPU backend occasionally drops a remote_compile response
+mid-read, which is a transient transport failure, not a property of the
+benchmark.  Round 2's official number was lost to exactly one such hiccup;
+the retry exists so one flake can never erase the headline evidence again.
 """
 
 import argparse
@@ -33,6 +39,15 @@ TRAIN_GFLOP_PER_IMAGE = 12.3
 PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0,   # bf16 peak
                "tpu v4": 275.0, "tpu v6 lite": 918.0, "tpu v6e": 918.0}
 
+# Substrings identifying a transient tunnel/transport failure worth retrying
+# (lower-cased match against "TypeName: message").  The round-2 loss was
+# "remote_compile: response body closed before all bytes were read".
+TRANSIENT_MARKERS = (
+    "remote_compile", "read body", "closed before", "unavailable",
+    "deadline", "connection", "socket", "reset by peer", "broken pipe",
+    "eof", "timed out", "timeout", "internal: ", "transport",
+)
+
 
 def _peak_tflops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
@@ -46,13 +61,8 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--profile", default=None, metavar="DIR",
-                        help="capture a jax.profiler trace of the timed "
-                             "steps into DIR")
-    args = parser.parse_args()
-
+def run(args) -> dict:
+    """One full benchmark attempt.  Returns the JSON-line dict."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -149,6 +159,62 @@ def main():
         out["step_ms"] = round(dt / steps * 1e3, 2)
         log(f"bench: MFU {mfu:.1%} (peak {peak} TFLOP/s bf16, "
             f"{TRAIN_GFLOP_PER_IMAGE} GFLOP/img train)")
+    else:
+        out["smoke"] = True
+    return out
+
+
+def _is_transient(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    # Deterministic failure categories: retrying re-runs the full
+    # init+warmup+measure cycle for minutes only to hit the same wall.
+    if "resource_exhausted" in msg or "invalid_argument" in msg \
+            or "out of memory" in msg or "unimplemented" in msg \
+            or "not implemented" in msg:
+        return False
+    if any(s in msg for s in TRANSIENT_MARKERS):
+        return True
+    # Any other XLA/jax runtime error on the tunneled backend is far more
+    # likely a transport hiccup than a benchmark bug (the code path is
+    # test-covered on CPU); err on the side of retrying those too.
+    return "xlaruntimeerror" in msg or "jaxruntimeerror" in msg
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--profile", default=None, metavar="DIR",
+                        help="capture a jax.profiler trace of the timed "
+                             "steps into DIR")
+    parser.add_argument("--attempts", type=int, default=3,
+                        help="max benchmark attempts before giving up")
+    args = parser.parse_args()
+
+    out = None
+    for attempt in range(1, max(1, args.attempts) + 1):
+        try:
+            out = run(args)
+            break
+        except Exception as e:  # noqa: BLE001 — classified below
+            transient = _is_transient(e)
+            log(f"bench: attempt {attempt}/{args.attempts} failed with "
+                f"{type(e).__name__}: {e} (transient={transient})")
+            if attempt >= args.attempts or not transient:
+                raise
+            # Best-effort fresh start: close a profiler trace the failed
+            # attempt may have left open (start_trace would raise on the
+            # retry) and drop compiled executables so the next attempt
+            # re-issues remote_compile on a fresh request.
+            try:
+                import jax
+                if args.profile:
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:
+                        pass
+                jax.clear_caches()
+            except Exception as ce:
+                log(f"bench: backend cleanup failed ({ce}); continuing")
+            time.sleep(5 * attempt)
     print(json.dumps(out), flush=True)
 
 

@@ -414,6 +414,11 @@ def main():
         t0 = time.perf_counter()
         row = fn()
         row["wall_s"] = round(time.perf_counter() - t0, 1)
+        if jax.default_backend() != "tpu":
+            # Explicit machine-readable marker: a CPU/virtual-mesh run
+            # exercises the code path but its numbers are NOT performance
+            # evidence; downstream readers must not mix them with real rows.
+            row["smoke"] = True
         results.append(row)
         print(json.dumps(row), flush=True)
     if args.out:

@@ -134,6 +134,28 @@ def center_crop(img: np.ndarray, size: int) -> np.ndarray:
     return img[top:top + size, left:left + size]
 
 
+def resize_short_side(img: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the short side equals ``size``, preserving aspect ratio.
+
+    Used by the eval path to upscale images smaller than the crop size —
+    without it an undersized image would pass through ``center_crop``
+    unchanged and later break batch collation with a ragged ``np.stack``.
+    """
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    if min(h, w) == size:
+        return img
+    if img.dtype != np.uint8:
+        raise ValueError(
+            f"cannot resize non-uint8 image (dtype={img.dtype}, "
+            f"shape={img.shape}); resize before converting")
+    scale = size / min(h, w)
+    nh = max(size, int(round(h * scale)))
+    nw = max(size, int(round(w * scale)))
+    return np.asarray(Image.fromarray(img).resize((nw, nh), Image.BILINEAR))
+
+
 def random_flip(img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
     return img[:, ::-1] if rng.rand() < 0.5 else img
 
@@ -199,6 +221,8 @@ class Augment:
             if self.flip:
                 img = random_flip(img, rng)
         else:
+            if min(img.shape[0], img.shape[1]) < self.image_size:
+                img = resize_short_side(img, self.image_size)
             if img.shape[0] != self.image_size or \
                     img.shape[1] != self.image_size:
                 img = center_crop(img, self.image_size)
@@ -260,6 +284,10 @@ class PrefetchIterator:
     with look-ahead.  Call :meth:`close` (or let the training process
     exit — the threads are daemons) to shut down.
     """
+
+    # Capability flag checked by training.extensions.Evaluator: the producer
+    # thread cannot rewind, so eval loops must not wrap this iterator.
+    rewindable = False
 
     def __init__(self, inner, transform: Optional[Callable] = None,
                  prefetch: int = 2, workers: int = 4):

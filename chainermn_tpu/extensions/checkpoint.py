@@ -128,6 +128,8 @@ class _OrbaxCheckpointer:
         self.comm = comm
         self.name = name
         self._ocp = ocp
+        # keep=0 -> max_to_keep=None: "retain every generation", matching
+        # the npz backend's GC (which skips collection when keep is 0).
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(os.path.join(path, name)),
             options=ocp.CheckpointManagerOptions(
@@ -170,7 +172,15 @@ def create_multi_node_checkpointer(communicator, path: str,
     path=...)`` 〔extensions/checkpoint.py〕.  ``backend="npz"`` (default)
     is the self-contained per-rank format; ``backend="orbax"`` delegates
     to the TPU ecosystem's checkpoint library (sharded arrays, async
-    commit protocol, same save/resume/GC interface)."""
+    commit protocol, same save/resume/GC interface).
+
+    ``keep`` retains the newest *keep* generations in both backends;
+    ``keep=0`` disables garbage collection entirely (every generation is
+    kept forever — both backends agree on this reading).
+    """
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0 (got {keep}); "
+                         f"0 means retain every generation")
     if backend == "orbax":
         return _OrbaxCheckpointer(communicator, path, name, keep)
     if backend != "npz":

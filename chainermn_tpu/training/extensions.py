@@ -110,6 +110,13 @@ class Evaluator:
     def __init__(self, iterator, eval_fn: Callable, comm,
                  prefix: str = "validation",
                  state_getter: Optional[Callable] = None):
+        if not hasattr(iterator, "reset") or \
+                not getattr(iterator, "rewindable", True):
+            raise ValueError(
+                f"Evaluator needs a rewindable iterator, got "
+                f"{type(iterator).__name__} (evaluation calls reset() every "
+                f"epoch).  Wrap the eval dataset in TransformDataset + "
+                f"SerialIterator instead of PrefetchIterator.")
         self.iterator = iterator
         self.eval_fn = eval_fn
         self.comm = comm

@@ -172,3 +172,22 @@ class TestOrbaxCheckpointer:
         state = self.make_state()
         restored, gen = ckpt.resume(state)
         assert gen is None and restored is state
+
+
+class TestCheckpointerValidation:
+    def test_negative_keep_rejected_both_backends(self, comm, tmp_path):
+        """keep semantics are pinned factory-wide: keep=0 means "retain all
+        generations" in BOTH backends (npz skips GC, orbax maps to
+        max_to_keep=None); negative values are rejected loudly."""
+        for backend in ("npz", "orbax"):
+            with pytest.raises(ValueError, match="keep must be >= 0"):
+                create_multi_node_checkpointer(
+                    comm, str(tmp_path), "snap", keep=-1, backend=backend)
+
+    def test_npz_keep_zero_retains_everything(self, comm, tmp_path):
+        ckpt = create_multi_node_checkpointer(
+            comm, str(tmp_path), "snap", keep=0)
+        state = {"w": jnp.ones((2,))}
+        for it in (1, 2, 3, 4):
+            ckpt.save(state, it)
+        assert ckpt._local_generations() == [1, 2, 3, 4]

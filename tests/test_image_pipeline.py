@@ -228,3 +228,32 @@ def test_prefetch_close_mid_production():
         transform=slow, prefetch=2, workers=2)
     pre.next()
     pre.close()  # producer may be mid-batch; must return promptly
+
+
+def test_augment_eval_upscales_undersized(image_tree):
+    """Eval transform must upscale images smaller than the crop size —
+    otherwise an undersized image passes through center_crop unchanged and
+    batch collation fails on a ragged np.stack (round-2 advisor finding)."""
+    from chainermn_tpu.datasets.image_pipeline import resize_short_side
+
+    aug = Augment(64, train=False)
+    small = np.random.RandomState(0).randint(
+        0, 255, size=(40, 48, 3), dtype=np.uint8)
+    out, _ = aug((small, 0))
+    assert out.shape == (64, 64, 3)
+    # aspect ratio preserved by the underlying resize
+    r = resize_short_side(small, 64)
+    assert min(r.shape[:2]) == 64 and r.shape[1] > r.shape[0]
+    with pytest.raises(ValueError, match="non-uint8"):
+        resize_short_side(small.astype(np.float32), 64)
+
+
+def test_prefetch_iterator_not_rewindable_flag(image_tree):
+    ds = ImageFolderDataset(str(image_tree), resize=32)
+    it = PrefetchIterator(SerialIterator(ds, 4, repeat=False), prefetch=1)
+    try:
+        assert it.rewindable is False
+        with pytest.raises(NotImplementedError):
+            it.reset()
+    finally:
+        it.close()

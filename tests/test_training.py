@@ -112,3 +112,21 @@ class TestMnistExampleScript:
             env=env, capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, out.stderr[-2000:]
         assert "final:" in out.stdout
+
+
+def test_evaluator_rejects_non_rewindable_iterator(comm):
+    """Evaluator.evaluate() resets its iterator every epoch; wrapping the
+    eval set in PrefetchIterator (which cannot rewind) must fail at
+    construction with a pointer to the supported recipe, not crash at the
+    first evaluation (round-2 advisor finding)."""
+    from chainermn_tpu.datasets import PrefetchIterator
+    from chainermn_tpu.datasets import make_classification
+
+    ds = make_classification(n=32, dim=4, n_classes=2, seed=0)
+    inner = SerialIterator(ds, 8, repeat=False)
+    it = PrefetchIterator(inner, prefetch=1)
+    try:
+        with pytest.raises(ValueError, match="rewindable"):
+            extensions.Evaluator(it, lambda p, b: {}, comm)
+    finally:
+        it.close()
