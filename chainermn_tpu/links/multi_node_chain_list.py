@@ -84,6 +84,10 @@ class MultiNodeChainList:
     def __init__(self, comm, n_stages: Optional[int] = None):
         self._comm = comm
         self._links: List[tuple] = []  # (module, rank_in, rank_out)
+        # Explicit controller-process pin per stage (None = round-robin
+        # default).  The reference let the user choose each link's MPI rank
+        # via add_link(chain, rank_in, rank_out); `process=` is that choice.
+        self._stage_proc: List[Optional[int]] = []
         self._n_stages_hint = n_stages
         self._stage_meshes: Optional[List[Mesh]] = None
         self._jits: dict = {}
@@ -105,9 +109,16 @@ class MultiNodeChainList:
         return int(getattr(self._comm, "host_size", 1))
 
     def stage_owner(self, s: int) -> int:
-        """Controller process that executes stage ``s`` (reference: the MPI
-        rank the link was assigned to; here registration order mod world)."""
-        return s % self._n_procs
+        """Controller process that executes stage ``s`` — the explicit
+        ``process=`` pin from :meth:`add_link` when given (reference: the MPI
+        rank the link was assigned to), else registration order mod world."""
+        if not 0 <= s < len(self._stage_proc):
+            raise ValueError(
+                f"stage reference {s} is out of range: this chain has "
+                f"{len(self._stage_proc)} registered stage(s) — check the "
+                f"rank_in/rank_out values passed to add_link")
+        pin = self._stage_proc[s]
+        return (s % self._n_procs) if pin is None else pin
 
     def is_local_stage(self, s: int) -> bool:
         return (self._n_procs == 1
@@ -129,10 +140,26 @@ class MultiNodeChainList:
                 | src << 10 | dst << 5 | occ)
 
     # -- registration --------------------------------------------------------
-    def add_link(self, module, rank_in: Ranks = None, rank_out: Ranks = None):
+    def add_link(self, module, rank_in: Ranks = None, rank_out: Ranks = None,
+                 process: Optional[int] = None):
         """Reference signature: ``add_link(chain, rank_in=..., rank_out=...)``.
-        The link's stage index is its registration order."""
+        The link's stage index is its registration order.
+
+        ``process`` pins the stage to a chosen controller process (the
+        reference's "which MPI rank owns this link" decision) — required for
+        deliberate placement of uneven models, e.g. a heavy encoder and a
+        light decoder on different hosts.  Default ``None`` keeps the
+        round-robin ``stage % host_size`` placement.  All processes must
+        register identical pins (the composition is SPMD at script level).
+        """
+        if process is not None:
+            n = self._n_procs
+            if not 0 <= process < n:
+                raise ValueError(
+                    f"add_link(process={process}) out of range: this "
+                    f"communicator spans {n} controller process(es)")
         self._links.append((module, rank_in, rank_out))
+        self._stage_proc.append(process)
         self._stage_meshes = None  # re-partition lazily
         return self
 

@@ -1,0 +1,90 @@
+"""Spawn a real multi-controller world for validation.
+
+The reference's multi-node tests ran under ``mpiexec -n N pytest``
+〔SURVEY.md §4〕; this rebuild has no launcher, so validation harnesses
+(tests, the driver's ``dryrun_multichip``) spawn N controller processes
+directly: each child gets the ``CHAINERMN_TPU_*`` bootstrap env contract,
+its own CPU device set, and reports results as a ``RESULT {json}`` stdout
+line.  This module is the ONE copy of that choreography — port pairing,
+env construction, harvest, and orphan cleanup (a surviving child blocked
+in a collective against a dead coordinator would outlive the whole run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, Optional
+
+
+def free_port_pair() -> int:
+    """A free TCP port whose successor is also free: the control plane
+    binds the given port and jax's coordination service binds port+1."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    t = socket.socket()
+    try:
+        t.bind(("127.0.0.1", port + 1))
+    except OSError:
+        t.close()
+        return free_port_pair()
+    t.close()
+    return port
+
+
+def spawn_world(worker_src: str, n_procs: int = 2, local_devices: int = 4,
+                timeout: float = 600.0,
+                repo: Optional[str] = None) -> Dict[int, dict]:
+    """Run ``worker_src`` in ``n_procs`` controller processes and return
+    ``{rank: parsed_result}`` from each worker's ``RESULT {json}`` line.
+
+    Workers bootstrap with ``chainermn_tpu.init_distributed(
+    local_device_count=...)`` using the ``CHAINERMN_TPU_*`` env contract
+    set here; ``CHAINERMN_TPU_REPO`` points at the package checkout (the
+    children drop axon_site from PYTHONPATH so they come up as pure-CPU
+    worlds).  On any failure every still-running child is killed before
+    the error propagates — no orphans.
+    """
+    if repo is None:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    coord = f"127.0.0.1:{free_port_pair()}"
+    procs = []
+    for r in range(n_procs):
+        env = dict(os.environ)
+        env.update({
+            "CHAINERMN_TPU_COORDINATOR": coord,
+            "CHAINERMN_TPU_NUM_PROCESSES": str(n_procs),
+            "CHAINERMN_TPU_PROCESS_ID": str(r),
+            "CHAINERMN_TPU_REPO": repo,
+            "PYTHONPATH": repo,
+            "JAX_PLATFORMS": "cpu",
+            "JAX_NUM_CPU_DEVICES": str(local_devices),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker_src], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results: Dict[int, dict] = {}
+    try:
+        for r, p in enumerate(procs):
+            stdout, stderr = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"worker rank {r} failed (rc={p.returncode})\n"
+                    f"stderr:\n{stderr[-3000:]}\nstdout:\n{stdout[-1000:]}")
+            lines = [l for l in stdout.splitlines()
+                     if l.startswith("RESULT ")]
+            if not lines:
+                raise RuntimeError(
+                    f"worker rank {r} produced no RESULT line:\n{stdout}")
+            results[r] = json.loads(lines[0][len("RESULT "):])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results

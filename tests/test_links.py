@@ -228,3 +228,35 @@ class TestSeq2Seq:
             if first is None:
                 first = float(loss)
         assert float(loss) < 0.5 * first
+
+
+class TestExplicitPlacement:
+    def test_process_pin_out_of_range_rejected(self, comm):
+        import flax.linen as nn
+        from chainermn_tpu.links import MultiNodeChainList
+
+        m = MultiNodeChainList(comm)
+        with pytest.raises(ValueError, match="out of range"):
+            m.add_link(nn.Dense(4), process=1)  # single controller: only 0
+
+    def test_process_pin_zero_is_noop_single_controller(self, comm):
+        import flax.linen as nn
+        from chainermn_tpu.links import MultiNodeChainList
+
+        m = MultiNodeChainList(comm)
+        m.add_link(nn.Dense(8), rank_in=None, rank_out=1, process=0)
+        m.add_link(nn.Dense(4), rank_in=0, rank_out=None, process=0)
+        assert [m.stage_owner(s) for s in range(2)] == [0, 0]
+        x = np.ones((4, 3), np.float32)
+        params = m.init(jax.random.key(0), x)
+        out = m.apply(params, x)
+        assert out.shape == (4, 4)
+
+    def test_dangling_stage_reference_rejected(self, comm):
+        import flax.linen as nn
+        from chainermn_tpu.links import MultiNodeChainList
+
+        m = MultiNodeChainList(comm)
+        m.add_link(nn.Dense(4), rank_in=None, rank_out=None)
+        with pytest.raises(ValueError, match="out of range"):
+            m.stage_owner(2)  # e.g. a typo'd rank_out=2 in a 1-stage chain
