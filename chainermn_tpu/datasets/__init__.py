@@ -12,9 +12,21 @@ from chainermn_tpu.datasets.scatter_dataset import (
     scatter_dataset,
     scatter_index,
 )
+from chainermn_tpu.datasets.nmt import (
+    Vocab,
+    bleu,
+    bucket_batches,
+    encode_pairs,
+    load_corpus,
+)
 from chainermn_tpu.datasets.synthetic import make_classification
 
 __all__ = [
+    "Vocab",
+    "bleu",
+    "bucket_batches",
+    "encode_pairs",
+    "load_corpus",
     "Augment",
     "ImageFolderDataset",
     "NpzImageDataset",

@@ -230,6 +230,13 @@ class MultiNodeChainList:
         shd = self._act_sharding(stage)
         return jax.tree.map(lambda a: jax.device_put(a, shd), x)
 
+    def place_activation(self, x, stage: int):
+        """Place an activation pytree on ``stage``'s device group
+        (batch-sharded) — for driving a stage's module directly outside
+        :meth:`apply`, e.g. autoregressive decoding against stage
+        parameters (the seq2seq example's translate path)."""
+        return self._place_act(x, stage)
+
     # -- init ----------------------------------------------------------------
     def init(self, rng, *inputs, stage_inputs: Optional[dict] = None):
         """Initialize per-stage parameters by tracing the composition once.

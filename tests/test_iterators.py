@@ -51,12 +51,7 @@ print("RESULT " + json.dumps({"rank": comm.rank, "batches": batches,
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from chainermn_tpu.utils.proc_world import free_port as _free_port
 
 
 def test_master_feeds_slave_two_processes():

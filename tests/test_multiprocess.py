@@ -52,12 +52,7 @@ print("RESULT " + json.dumps(out))
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from chainermn_tpu.utils.proc_world import free_port as _free_port
 
 
 @pytest.mark.parametrize("force_py", ["0", "1"],

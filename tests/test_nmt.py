@@ -1,0 +1,144 @@
+"""NMT corpus machinery: vocabulary, bucketing/padding/masking, BLEU.
+
+Reference behavior analogue (SURVEY.md §2.6): the reference seq2seq
+example's corpus loading, vocab construction, and held-out translation
+metric 〔examples/seq2seq/seq2seq.py〕, rebuilt as length-bucketed static
+shapes for XLA.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.datasets.nmt import (
+    BOS_ID,
+    EOS_ID,
+    PAD_ID,
+    UNK_ID,
+    Vocab,
+    bleu,
+    bucket_batches,
+    encode_pairs,
+    load_corpus,
+)
+
+
+class TestVocab:
+    def test_specials_pinned_and_frequency_order(self):
+        v = Vocab.build([["b", "a", "b"], ["b", "c", "a"]])
+        assert v.itos[:4] == ["<pad>", "<bos>", "<eos>", "<unk>"]
+        # b(3) before a(2) before c(1)
+        assert v.itos[4:] == ["b", "a", "c"]
+        assert (PAD_ID, BOS_ID, EOS_ID, UNK_ID) == (0, 1, 2, 3)
+
+    def test_deterministic_tie_break(self):
+        a = Vocab.build([["x", "y"]])
+        b = Vocab.build([["y", "x"]])
+        assert a.itos == b.itos  # lexicographic among equal counts
+
+    def test_unk_and_max_size(self):
+        v = Vocab.build([["a", "a", "b", "c"]], max_size=6)
+        assert len(v) == 6  # 4 specials + 2 kept
+        assert v.encode(["a", "zzz"]) == [v.stoi["a"], UNK_ID]
+        with pytest.raises(ValueError, match="no room"):
+            Vocab.build([["a"]], max_size=4)
+
+    def test_decode_stops_at_eos(self):
+        v = Vocab.build([["hello", "world"]])
+        ids = v.encode(["hello", "world"]) + [EOS_ID] + v.encode(["hello"])
+        assert v.decode([BOS_ID] + ids) == ["hello", "world"]
+        assert v.decode([PAD_ID, PAD_ID]) == []
+
+
+class TestLoadCorpus:
+    def test_load_filter_and_mismatch(self, tmp_path):
+        src = tmp_path / "s.txt"
+        tgt = tmp_path / "t.txt"
+        src.write_text("a b c\n\nx y\nlong " + "w " * 60 + "\n")
+        tgt.write_text("A B\nZ\nX Y\nL\n")
+        pairs = load_corpus(str(src), str(tgt), max_len=50)
+        # line 2 (empty src) and line 4 (overlong src) skipped
+        assert pairs == [(["a", "b", "c"], ["A", "B"]),
+                         (["x", "y"], ["X", "Y"])]
+        tgt.write_text("A B\n")
+        with pytest.raises(ValueError, match="mismatch"):
+            load_corpus(str(src), str(tgt))
+
+
+class TestBucketBatches:
+    def _examples(self, lengths, seed=0):
+        rng = np.random.RandomState(seed)
+        return [(rng.randint(4, 10, size=l).astype(np.int32),
+                 np.concatenate([rng.randint(4, 10, size=l),
+                                 [EOS_ID]]).astype(np.int32))
+                for l in lengths]
+
+    def test_shapes_masks_and_teacher_forcing(self):
+        ex = self._examples([3, 3, 5, 5])
+        batches = list(bucket_batches(ex, 2, step=4, shuffle=False))
+        assert len(batches) == 2
+        by_shape = {b["src"].shape[1]: b for b in batches}
+        assert set(by_shape) == {4, 8}  # lengths rounded up to step
+        b = by_shape[4]  # the two length-3 examples
+        assert b["src"].shape == (2, 4)
+        assert b["tgt_in"].shape == b["tgt_out"].shape == (2, 4)
+        assert (b["src"][:, 3] == PAD_ID).all()
+        # teacher forcing: tgt_in = BOS + tgt_out[:-1]
+        assert (b["tgt_in"][:, 0] == BOS_ID).all()
+        np.testing.assert_array_equal(b["tgt_in"][:, 1:], b["tgt_out"][:, :-1])
+        # mask covers the real tokens + EOS only
+        np.testing.assert_array_equal(b["mask"],
+                                      [[1, 1, 1, 1], [1, 1, 1, 1]])
+        assert (b["src_len"] == 3).all()
+
+    def test_drop_remainder_vs_wrap_pad(self):
+        ex = self._examples([3, 3, 3])
+        assert len(list(bucket_batches(ex, 2, shuffle=False))) == 1
+        batches = list(bucket_batches(ex, 2, shuffle=False,
+                                      drop_remainder=False))
+        assert len(batches) == 2
+        tail = batches[1]
+        assert tail["n_real"] == 1
+        assert tail["src"].shape[0] == 2  # wrap-padded to batch size
+        assert tail["mask"][1].sum() == 0  # padding row masked out
+
+    def test_epoch_shuffle_differs_but_covers(self):
+        ex = self._examples([3] * 8)
+        a = [b["src"].tobytes() for b in bucket_batches(ex, 4, seed=0)]
+        c = [b["src"].tobytes() for b in bucket_batches(ex, 4, seed=1)]
+        assert set(a) != set(c) or a != c
+
+
+class TestBleu:
+    def test_perfect_match(self):
+        refs = [["the", "cat", "sat", "on", "the", "mat"]]
+        assert bleu(refs, refs) == pytest.approx(1.0)
+
+    def test_zero_on_disjoint(self):
+        assert bleu([["a", "b", "c", "d"]], [["w", "x", "y", "z"]],
+                    smooth=False) == 0.0
+
+    def test_brevity_penalty(self):
+        ref = [["a", "b", "c", "d", "e", "f"]]
+        short = [["a", "b", "c"]]
+        full = bleu(ref, ref)
+        clipped = bleu(short, ref)
+        assert clipped < full
+        # prefix has perfect precisions; score must equal the BP alone
+        assert clipped == pytest.approx(math.exp(1 - 6 / 3), rel=1e-6)
+
+    def test_known_partial_overlap(self):
+        hyp = [["the", "cat", "sat", "on", "mat"]]
+        ref = [["the", "cat", "sat", "on", "the", "mat"]]
+        score = bleu(hyp, ref)
+        assert 0.0 < score < 1.0
+        with pytest.raises(ValueError, match="count mismatch"):
+            bleu(hyp, ref + ref)
+
+
+def test_encode_pairs_appends_eos():
+    v = Vocab.build([["a", "b"]])
+    enc = encode_pairs([(["a"], ["b"])], v, v)
+    src, tgt = enc[0]
+    assert tgt[-1] == EOS_ID and src[-1] == v.stoi["a"]

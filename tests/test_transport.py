@@ -15,12 +15,7 @@ from chainermn_tpu.runtime.control_plane import SocketControlPlane
 from chainermn_tpu.runtime.transport import PyTransport
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from chainermn_tpu.utils.proc_world import free_port as _free_port
 
 
 def _native_available():
