@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""DCN transport microbench: native C++ core vs pure-Python fallback.
+
+Measures point-to-point goodput of the repo's framed-TCP transport
+(``runtime/dcn_transport.cpp`` / ``runtime/transport.py`` — the rebuild's
+analogue of the reference's MPI wire layer 〔SURVEY.md §2.3〕) between two
+real processes over localhost, per payload size.  Ping-pong timing: rank 0
+sends, rank 1 echoes; one-way goodput = 2 * bytes / round-trip.
+
+This feeds the MEASURED DCN column of docs/performance.md's scaling table
+(replacing the assumed bandwidth) and validates the native core's reason
+to exist: it must not be slower than the fallback.
+
+    python benchmarks/bench_transport.py [--out FILE] [--quick]
+
+Prints one JSON line per (backend, payload) plus a summary comparison.
+Localhost loopback is an upper bound for this host's wire stack (no NIC),
+which is exactly what the scaling table needs: the per-hop software
+overhead floor.
+"""
+
+import argparse
+import json
+import sys
+
+DEFAULT_SIZES = [1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 22,
+                 1 << 24, 1 << 26]  # 1 KB .. 64 MB
+QUICK_SIZES = [1 << 10, 1 << 16, 1 << 20]
+
+_WORKER_TEMPLATE = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+os.environ["CHAINERMN_TPU_PURE_PY_TRANSPORT"] = "%(force_py)s"
+
+from chainermn_tpu.runtime.transport import create_transport
+
+rank = int(os.environ["CHAINERMN_TPU_PROCESS_ID"])
+coord = os.environ["CHAINERMN_TPU_COORDINATOR"]
+sizes = %(sizes)r
+reps_cap = %(reps_cap)d
+
+t = create_transport(rank, 2, coord)
+backend = type(t).__name__
+TAG = 7
+results = {}
+for sz in sizes:
+    reps = max(3, min(reps_cap, (1 << 24) // sz))
+    payload = b"\x5a" * sz
+    if rank == 0:
+        t.send(1, TAG, payload)          # warm the connection + allocator
+        assert len(t.recv(1, TAG)) == sz
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            t.send(1, TAG, payload)
+            r = t.recv(1, TAG)
+        dt = time.perf_counter() - t0
+        assert len(r) == sz
+        results[str(sz)] = 2.0 * sz * reps / dt / 1e6  # one-way MB/s
+    else:
+        for _ in range(reps + 1):
+            t.send(0, TAG, t.recv(0, TAG))
+t.close()
+print("RESULT " + json.dumps({"rank": rank, "backend": backend,
+                              "mb_per_s": results}))
+"""
+
+
+def run_sweep(sizes, force_py: bool, reps_cap: int = 50) -> dict:
+    """Two-process localhost sweep.  Returns {"backend": name,
+    "mb_per_s": {size_str: MB/s}} from rank 0's measurements."""
+    from chainermn_tpu.utils.proc_world import spawn_world
+
+    worker = _WORKER_TEMPLATE % {
+        "force_py": "1" if force_py else "0",
+        "sizes": list(sizes), "reps_cap": reps_cap}
+    results = spawn_world(worker, n_procs=2, local_devices=1, timeout=600)
+    out = {"backend": results[0]["backend"],
+           "mb_per_s": results[0]["mb_per_s"]}
+    if force_py:
+        assert out["backend"] == "PyTransport", out["backend"]
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--quick", action="store_true",
+                    help="3 sizes, few reps (smoke)")
+    args = ap.parse_args()
+    sizes = QUICK_SIZES if args.quick else DEFAULT_SIZES
+    reps_cap = 5 if args.quick else 50
+
+    runs = {}
+    for label, force_py in (("native", False), ("python", True)):
+        r = run_sweep(sizes, force_py, reps_cap)
+        runs[label] = r
+        for sz in sizes:
+            print(json.dumps({
+                "metric": "dcn_transport_goodput",
+                "backend": r["backend"], "payload_bytes": sz,
+                "value": round(r["mb_per_s"][str(sz)], 1),
+                "unit": "MB/s"}), flush=True)
+
+    if runs["native"]["backend"] == "PyTransport":
+        print(json.dumps({"note": "native core unavailable; both sweeps "
+                                  "ran the Python fallback"}))
+    else:
+        big = str(sizes[-1])
+        nat = runs["native"]["mb_per_s"][big]
+        py = runs["python"]["mb_per_s"][big]
+        # the native core must at least match the fallback (10% noise floor)
+        assert nat >= 0.9 * py, (
+            f"native transport slower than fallback at {big}B: "
+            f"{nat:.0f} vs {py:.0f} MB/s")
+        print(json.dumps({"summary": "native_vs_python",
+                          "payload_bytes": int(big),
+                          "native_mb_s": round(nat, 1),
+                          "python_mb_s": round(py, 1),
+                          "speedup": round(nat / py, 2)}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(runs, f, indent=2)
+    return runs
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -3,6 +3,7 @@ in the reference, supplied here so the training integration is standalone)."""
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -26,6 +27,11 @@ class SerialIterator:
         self.is_new_epoch = False
         self._order = self._new_order()
         self._pos = 0
+        # Guards next() vs state_dict(): a PrefetchIterator's producer
+        # thread draws batches while a Snapshot extension serializes state
+        # from the trainer thread — without this the snapshot could tear
+        # (pos from before a reshuffle, order/rng from after).
+        self._state_lock = threading.Lock()
 
     def _new_order(self):
         n = len(self.dataset)
@@ -42,34 +48,36 @@ class SerialIterator:
         return self
 
     def __next__(self):
-        n = len(self.dataset)
-        if self._pos >= n:
-            if not self._repeat:
-                raise StopIteration
-            self.epoch += 1
-            self._order = self._new_order()
-            self._pos = 0
-        start, end = self._pos, min(self._pos + self.batch_size, n)
-        idx = self._order[start:end]
-        if len(idx) < self.batch_size and self._repeat:
-            # wrap to keep batches full (static shapes keep XLA happy)
-            extra = self._order[: self.batch_size - len(idx)]
-            idx = np.concatenate([idx, extra])
-            self.epoch += 1
-            self._order = self._new_order()
-            self._pos = 0
-            self.is_new_epoch = True
-        elif end >= n and self._repeat:
-            # exact epoch boundary: advance the epoch now so reporting and
-            # epoch-triggers see the completed epoch immediately
-            self.is_new_epoch = True
-            self.epoch += 1
-            self._order = self._new_order()
-            self._pos = 0
-        else:
-            self.is_new_epoch = end >= n
-            self._pos = end
-        self.iteration += 1
+        with self._state_lock:
+            n = len(self.dataset)
+            if self._pos >= n:
+                if not self._repeat:
+                    raise StopIteration
+                self.epoch += 1
+                self._order = self._new_order()
+                self._pos = 0
+            start, end = self._pos, min(self._pos + self.batch_size, n)
+            idx = self._order[start:end]
+            if len(idx) < self.batch_size and self._repeat:
+                # wrap to keep batches full (static shapes keep XLA happy)
+                extra = self._order[: self.batch_size - len(idx)]
+                idx = np.concatenate([idx, extra])
+                self.epoch += 1
+                self._order = self._new_order()
+                self._pos = 0
+                self.is_new_epoch = True
+            elif end >= n and self._repeat:
+                # exact epoch boundary: advance the epoch now so reporting
+                # and epoch-triggers see the completed epoch immediately
+                self.is_new_epoch = True
+                self.epoch += 1
+                self._order = self._new_order()
+                self._pos = 0
+            else:
+                self.is_new_epoch = end >= n
+                self._pos = end
+            self.iteration += 1
+        # dataset access (possibly decode-heavy) stays outside the lock
         examples = [self.dataset[int(i)] for i in idx]
         return _collate(examples) if self._collate else examples
 
@@ -78,6 +86,47 @@ class SerialIterator:
     @property
     def epoch_detail(self):
         return self.epoch + self._pos / max(len(self.dataset), 1)
+
+    # -- checkpointable state (the reference serialized its iterators into
+    # snapshots 〔extensions/checkpoint.py usage〕; same contract here) ----
+    def state_dict(self) -> dict:
+        """Position, epoch bookkeeping, current order, and RNG state as a
+        flat dict of numpy arrays — checkpointer-friendly (every leaf is
+        an array; structure is static for a given dataset).  Atomic with
+        respect to :meth:`next` (a prefetching producer thread may be
+        drawing batches while a snapshot extension serializes)."""
+        with self._state_lock:
+            keys, pos, has_gauss, cached = self._rng.get_state()[1:]
+            return {
+                "epoch": np.int64(self.epoch),
+                "iteration": np.int64(self.iteration),
+                "is_new_epoch": np.int64(self.is_new_epoch),
+                "pos": np.int64(self._pos),
+                "order": np.asarray(self._order, np.int64),
+                "rng_keys": np.asarray(keys, np.uint32),
+                "rng_pos": np.int64(pos),
+                "rng_has_gauss": np.int64(has_gauss),
+                "rng_cached": np.float64(cached),
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore :meth:`state_dict` output: the next batch drawn equals
+        the one the snapshotted iterator would have drawn."""
+        order = np.asarray(state["order"])
+        if len(order) != len(self.dataset):
+            raise ValueError(
+                f"iterator state is for a {len(order)}-example dataset; "
+                f"this iterator has {len(self.dataset)} examples")
+        with self._state_lock:
+            self.epoch = int(state["epoch"])
+            self.iteration = int(state["iteration"])
+            self.is_new_epoch = bool(int(state["is_new_epoch"]))
+            self._pos = int(state["pos"])
+            self._order = order
+            self._rng.set_state((
+                "MT19937", np.asarray(state["rng_keys"], np.uint32),
+                int(state["rng_pos"]), int(state["rng_has_gauss"]),
+                float(state["rng_cached"])))
 
 
 def _collate(examples):

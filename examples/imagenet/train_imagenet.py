@@ -98,6 +98,17 @@ def main():
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--checkpoint", default=None, metavar="DIR",
+                        help="periodic multi-node snapshots into DIR "
+                             "(params, optimizer/model state, iterator "
+                             "position) with auto-resume on restart; use "
+                             "--prefetch 0 for exact-position resume (a "
+                             "prefetching loader looks ahead up to "
+                             "--prefetch batches)")
+    parser.add_argument("--checkpoint-freq", type=int, default=None,
+                        metavar="N", help="snapshot every N iterations "
+                                          "(default: every epoch)")
+    parser.add_argument("--checkpoint-keep", type=int, default=2)
     parser.add_argument("--out", "-o", default="result")
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
@@ -142,15 +153,13 @@ def main():
     local_bs = args.batchsize * comm.size // comm.host_size
     # raw (uncollated) batches when a per-sample transform will run; the
     # prefetch loop decodes/augments/collates ahead of the device step
-    train_iter = SerialIterator(train, local_bs, shuffle=True,
-                                seed=args.seed, collate=augment is None)
-    if args.prefetch > 0:
-        train_iter = PrefetchIterator(train_iter, transform=augment,
-                                      prefetch=args.prefetch,
-                                      workers=args.loader_workers)
-    elif augment is not None:
+    base_iter = SerialIterator(train, local_bs, shuffle=True,
+                               seed=args.seed, collate=augment is None)
+    if args.prefetch <= 0 and augment is not None:
         raise SystemExit("--prefetch 0 requires collatable data "
                          "(no --data folder / augmentation)")
+    # (the PrefetchIterator wrap happens after checkpoint resume, so a
+    # restored position is what the producer thread starts from)
 
     # validation set: real folder when given, else a held-out synthetic set
     if args.val_data:
@@ -200,9 +209,47 @@ def main():
         double_buffering=args.double_buffering)
     opt_state = init_opt_state(comm, optimizer, params)
 
-    if has_bn:
-        model_state = init_model_state(comm, variables["batch_stats"])
+    model_state = (init_model_state(comm, variables["batch_stats"])
+                   if has_bn else None)
 
+    # ---- checkpoint / auto-resume (reference: the examples wove
+    # create_multi_node_checkpointer into training 〔extensions/checkpoint.py〕)
+    ckpt = None
+    start_iteration = 0
+    if args.checkpoint:
+        ckpt = chainermn_tpu.create_multi_node_checkpointer(
+            comm, args.checkpoint, name=f"imagenet-{args.arch}",
+            keep=args.checkpoint_keep)
+
+        def make_ckpt_state(params, model_state, opt_state, iteration):
+            s = {"params": params, "opt_state": opt_state,
+                 "iteration": np.int64(iteration),
+                 "iterator": base_iter.state_dict()}
+            if has_bn:
+                s["model_state"] = model_state
+            return s
+
+        restored, gen = ckpt.resume(
+            make_ckpt_state(params, model_state, opt_state, 0))
+        if gen is not None:
+            params, opt_state = restored["params"], restored["opt_state"]
+            if has_bn:
+                model_state = restored["model_state"]
+            base_iter.load_state_dict(restored["iterator"])
+            start_iteration = int(restored["iteration"])
+            # dropout keys continue from the restored step, not step 0
+            step_counter = itertools.count(start_iteration)
+            if comm.rank == 0:
+                print(f"resumed from snapshot at iteration "
+                      f"{start_iteration} (epoch {base_iter.epoch})")
+
+    train_iter = base_iter
+    if args.prefetch > 0:
+        train_iter = PrefetchIterator(base_iter, transform=augment,
+                                      prefetch=args.prefetch,
+                                      workers=args.loader_workers)
+
+    if has_bn:
         def loss_fn(p, state, batch):
             x, y, it = batch
             if x.dtype == jnp.uint8:   # real-image path ships uint8
@@ -241,7 +288,17 @@ def main():
         updater = StandardUpdater(train_iter, step, params, opt_state, comm,
                                   convert_batch=convert)
 
+    updater.iteration = start_iteration
     trainer = Trainer(updater, (args.epoch, "epoch"), out=args.out)
+    if ckpt is not None:
+        trainer.extend(extensions.Snapshot(
+            ckpt,
+            lambda t: make_ckpt_state(
+                t.updater.params,
+                getattr(t.updater, "model_state", None),
+                t.updater.opt_state, t.updater.iteration),
+            trigger=((args.checkpoint_freq, "iteration")
+                     if args.checkpoint_freq else (1, "epoch"))))
     if has_bn:
         trainer.extend(chainermn_tpu.AllreducePersistent(
             comm, lambda t: t.updater.model_state,
