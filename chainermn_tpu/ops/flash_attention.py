@@ -4,21 +4,25 @@
 CUDA elementwise strings — SURVEY.md §2.3; this is the TPU analogue for
 the attention hot op used by the sequence-parallel extension).
 
-Forward: one `pallas_call` program per (batch*head, q-tile): the q tile
-lives in VMEM, K/V for the whole (local) sequence stream through VMEM,
-and the softmax is computed online (running max / denominator, never a
-full [T, T] score matrix in HBM).  MXU does the two matmuls per K/V
-tile; the online-softmax rescale rides the VPU.  The per-row logsumexp
-is written out as a residual so the backward never re-derives it.
+Forward: K/V-STREAMING grid (round 3) — grid (batch*head, q-tile,
+k-tile): the q tile and the online-softmax accumulators (acc, running
+max, denominator) live in VMEM scratch across the k-tile grid steps,
+while each K/V TILE is fetched by the Pallas pipeline per step.  VMEM
+residency is O(block) rather than O(T), which lifts the previous
+full-sequence-resident bound (~T=12k at D=128) to HBM capacity; the
+pipelined tile fetches overlap the MXU matmuls.  The softmax is online
+(never a full [T, T] score matrix anywhere); the per-row logsumexp is
+written out as a residual so the backward never re-derives it.
 
-Backward: two Pallas kernels in the standard flash-gradient shape —
-one program per K/V tile accumulating (dk, dv) over q tiles, one
-program per Q tile accumulating dq over K/V tiles — each recomputing
-its score tile from q/k and the saved logsumexp, so the [T, T] matrix
-is materialized in NEITHER direction and training memory stays
-O(T * block) end to end.  A pure-XLA blockwise backward with identical
-math is kept (``bwd_impl="blockwise"``) as the cross-check oracle for
-the gradient-parity tests.
+Backward: two streaming Pallas kernels in the standard flash-gradient
+shape — grid (bh, k-tile, q-tile) accumulating (dk, dv) in scratch
+while q/dO/lse/delta tiles stream, and grid (bh, q-tile, k-tile)
+accumulating dq while K/V tiles stream — each recomputing its score
+tile from q/k and the saved logsumexp, so the [T, T] matrix is
+materialized in NEITHER direction and VMEM stays O(block) end to end.
+A pure-XLA blockwise backward with identical math is kept
+(``bwd_impl="blockwise"``) as the cross-check oracle for the
+gradient-parity tests.
 
 Masking and dropout:
 
@@ -58,8 +62,8 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-_BLOCK_Q = 256
-_BLOCK_K = 256
+_BLOCK_Q = 1024  # measured optimum on v5e (benchmarks: 81 TFLOP/s fwd at
+_BLOCK_K = 1024  # T=8k vs 24 at 256/256 — per-grid-step overhead amortizes)
 _NEG_INF = -1e30
 _LSE_SENTINEL = 1e30  # lse for fully-masked rows: exp(s - sentinel) == 0
 
@@ -129,42 +133,60 @@ def _mask_tile(causal, q_pos, k_pos, seg_q, seg_k):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal,
                 has_seg, dropout_rate, has_offsets):
-    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; optional qseg [1, BQ],
-    # kseg [1, T], seed [1, 1], offs [1, 2]; outputs o [1, BQ, D],
-    # lse [1, BQ].
-    qseg_ref, kseg_ref, seed_ref, offs_ref, (o_ref, lse_ref) = _unpack_rest(
+    # Streaming grid (bh, q-tile, k-tile): q_ref [1, BQ, D] (fixed per
+    # (bh, j)); k_ref/v_ref [1, BK, D] = THIS grid step's tile; optional
+    # qseg [1, 1, BQ], kseg [1, 1, BK], seed [1, 1], offs [1, 2]; outputs
+    # o [1, BQ, D], lse [1, 1, BQ] (written at the last k step); scratch
+    # acc [BQ, D], m [BQ, 1], l [BQ, 1] persist across the k dimension.
+    qseg_ref, kseg_ref, seed_ref, offs_ref, rest = _unpack_rest(
         rest, has_seg, dropout_rate, has_offsets)
+    o_ref, lse_ref, acc_s, m_s, l_s = rest
 
     q = q_ref[0]                                         # [BQ, D]
-    t = k_ref.shape[1]
-    bq = q.shape[0]
+    k = k_ref[0]                                         # [BK, D]
+    v = v_ref[0]
+    bq, d = q.shape
+    bk = k.shape[0]
+    kk = pl.program_id(2)
+    n_k = pl.num_programs(2)
     q_off = pl.program_id(1) * bq
+    k_off = kk * bk
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
     # global position offsets (ring-attention blocks of a longer sequence)
     goff_q = offs_ref[0, 0] if has_offsets else 0
     goff_k = offs_ref[0, 1] if has_offsets else 0
-    q_pos = goff_q + q_off + jax.lax.broadcasted_iota(
-        jnp.int32, (bq, block_k), 0)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :]
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :]
+    @pl.when(kk == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    # causal full-tile skip: tile contributes only if some q row can see
+    # its first k row (the fetch still pipelines; the MXU work is skipped)
+    run = ((goff_q + q_off + bq - 1 >= goff_k + k_off)
+           if causal else (kk >= 0))
+
+    @pl.when(run)
+    def _tile():
+        q_pos = goff_q + q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        k_pos = goff_k + k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
         # scale after the matmul — same op order as the unfused reference,
         # so results match it to tight tolerance
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        k_pos = goff_k + j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
         seg_q = qseg_ref[0, 0] if has_seg else None
-        seg_k = (kseg_ref[0, 0, pl.dslice(j * block_k, block_k)]
-                 if has_seg else None)
+        seg_k = kseg_ref[0, 0] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
+        m = m_s[...]
+        l = l_s[...]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         if mask is not None:
@@ -172,34 +194,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_k,
             # exp would give 1 — zero the masked entries explicitly
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        l_s[...] = l * alpha + p.sum(axis=1, keepdims=True)
+        m_s[...] = m_new
         if dropout_rate > 0.0:
             keep = _keep_mask(seed, bh_idx, q_pos, k_pos, dropout_rate)
             p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         else:
             p_use = p
-        acc_new = acc * alpha + jax.lax.dot_general(
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
             p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
 
-    n_k = t // block_k
-    if causal:
-        # K/V tiles whose first global row is past this q tile's last
-        # global row are fully masked; skip them.  The bound is traced
-        # either way (program_id, and offsets when present).
-        n_k = jnp.minimum(n_k, jnp.maximum(
-            0, (goff_q + q_off + bq - 1 - goff_k) // block_k + 1))
-    d = q.shape[1]
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
-    empty = l == 0.0
-    o_ref[0] = (acc / jnp.where(empty, 1.0, l)).astype(o_ref.dtype)
-    lse = jnp.where(empty[:, 0], _LSE_SENTINEL, m[:, 0] + jnp.log(
-        jnp.where(empty[:, 0], 1.0, l[:, 0])))
-    lse_ref[0, 0] = lse.astype(jnp.float32)
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        l = l_s[...]
+        empty = l == 0.0
+        o_ref[0] = (acc_s[...] / jnp.where(empty, 1.0, l)).astype(
+            o_ref.dtype)
+        lse = jnp.where(empty[:, 0], _LSE_SENTINEL,
+                        m_s[...][:, 0] + jnp.log(
+                            jnp.where(empty[:, 0], 1.0, l[:, 0])))
+        lse_ref[0, 0] = lse.astype(jnp.float32)
+
+
+def _scratch(shapes_dtypes):
+    """VMEM scratch allocations — the accumulators that persist across the
+    streaming grid dimension (interpret mode allocates them as arrays).
+    Installs without pltpu (pure-CPU jax) fall back to the memory-space-
+    agnostic MemoryRef, which the interpreter accepts."""
+    if pltpu is not None:
+        return [pltpu.VMEM(s, dt) for s, dt in shapes_dtypes]
+    return [pl.MemoryRef(jax.core.ShapedArray(s, dt), pl.ANY)
+            for s, dt in shapes_dtypes]
 
 
 def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
@@ -220,15 +246,14 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
     has_offsets = offs is not None
 
     kern = functools.partial(_fwd_kernel, sm_scale=scale, causal=causal,
-                             block_k=bk, has_seg=has_seg,
-                             dropout_rate=dropout_rate,
+                             has_seg=has_seg, dropout_rate=dropout_rate,
                              has_offsets=has_offsets)
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     ins = [qf, kf, vf]
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
-        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw),
+        pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
     ]
     if has_seg:
         # segment ids are per-batch; heads share them (index map i // h).
@@ -237,26 +262,30 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
         # host-side vectors ride as [*, 1, T].
         ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
         in_specs += [
-            pl.BlockSpec((1, 1, bq), lambda i, j: (i // h, 0, j), **kw),
-            pl.BlockSpec((1, 1, t), lambda i, j: (i // h, 0, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i // h, 0, j), **kw),
+            pl.BlockSpec((1, 1, bk), lambda i, j, kk: (i // h, 0, kk), **kw),
         ]
     if dropout_rate > 0.0:
         ins.append(seed.reshape(1, 1))
-        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw))
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, j, kk: (0, 0), **kw))
     if has_offsets:
         ins.append(offs.reshape(1, 2))
-        in_specs.append(pl.BlockSpec((1, 2), lambda i, j: (0, 0), **kw))
+        in_specs.append(pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0), **kw))
     # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
     out_shape = [_shape_like(qf, (b * h, t, d), q.dtype),
                  _shape_like(qf, (b * h, 1, t), jnp.float32)]
     out, lse = pl.pallas_call(
         kern,
-        grid=(b * h, t // bq),
+        grid=(b * h, t // bq, t // bk),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-                   pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw)],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i, 0, j), **kw)],
         out_shape=out_shape,
+        scratch_shapes=_scratch([((bq, d), jnp.float32),
+                                 ((bq, 1), jnp.float32),
+                                 ((bq, 1), jnp.float32)]),
         interpret=interpret,
     )(*ins)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
@@ -267,44 +296,58 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
-                sm_scale, causal, block_q, has_seg, dropout_rate,
+                sm_scale, causal, has_seg, dropout_rate,
                 has_offsets, with_lse):
-    # q_ref/g_ref: [1, T, D] (resident); k_ref/v_ref: [1, BK, D] tile;
-    # lse_ref/delta_ref: [1, 1, T]; optional glse [1, 1, T];
-    # outputs dk/dv: [1, BK, D].
+    # Streaming grid (bh, k-tile, q-tile): k_ref/v_ref [1, BK, D] fixed
+    # per (bh, kk); q_ref/g_ref [1, BQ, D] = this step's q tile;
+    # lse_ref/delta_ref [1, 1, BQ] tiles; optional glse [1, 1, BQ];
+    # outputs dk/dv [1, BK, D] written at the last q step; scratch
+    # dk/dv accumulators persist across the q dimension.
     qseg_ref, kseg_ref, seed_ref, offs_ref, outs = _unpack_rest(
         rest, has_seg, dropout_rate, has_offsets)
     if with_lse:
-        glse_ref, dk_ref, dv_ref = outs
+        glse_ref, dk_ref, dv_ref, dk_s, dv_s = outs
     else:
         glse_ref = None
-        dk_ref, dv_ref = outs
+        dk_ref, dv_ref, dk_s, dv_s = outs
 
     k = k_ref[0]                                          # [BK, D]
     v = v_ref[0]
-    t = q_ref.shape[1]
+    q = q_ref[0]                                          # [BQ, D]
+    g = g_ref[0]
     bk = k.shape[0]
-    d = k.shape[1]
-    bq = block_q
+    bq = q.shape[0]
+    qq = pl.program_id(2)
+    n_q = pl.num_programs(2)
     k_off = pl.program_id(1) * bk
+    q_off = qq * bq
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
     goff_q = offs_ref[0, 0] if has_offsets else 0
     goff_k = offs_ref[0, 1] if has_offsets else 0
-    k_pos = goff_k + k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    seg_k = (kseg_ref[0, 0] if has_seg else None)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(i * bq, bq), :]
-        g = g_ref[0, pl.dslice(i * bq, bq), :]
-        lse = lse_ref[0, 0, pl.dslice(i * bq, bq)]
-        delta = delta_ref[0, 0, pl.dslice(i * bq, bq)]
+    @pl.when(qq == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    # causal: this q tile contributes only if its last row sees the
+    # k tile's first row
+    run = ((goff_q + q_off + bq - 1 >= goff_k + k_off)
+           if causal else (qq >= 0))
+
+    @pl.when(run)
+    def _tile():
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        k_pos = goff_k + k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        q_pos = goff_q + q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        q_pos = goff_q + i * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 0)
-        seg_q = qseg_ref[0, 0, pl.dslice(i * bq, bq)] if has_seg else None
+        seg_q = qseg_ref[0, 0] if has_seg else None
+        seg_k = kseg_ref[0, 0] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])                     # normalized probs
         if mask is not None:
@@ -319,70 +362,74 @@ def _dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
         else:
             a_drop = a
             da = dp
-        dv = dv + jax.lax.dot_general(
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
             a_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = a * (da - delta[:, None]) * sm_scale
         if with_lse:
             # cotangent flowing into the logsumexp output: d lse_i / d s_ij
             # = a_ij (same a as above), in scaled-score space
-            glse = glse_ref[0, 0, pl.dslice(i * bq, bq)]
+            glse = glse_ref[0, 0]
             ds = ds + a * glse[:, None] * sm_scale
-        dk = dk + jax.lax.dot_general(
+        dk_s[...] = dk_s[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    n_q = t // bq
-    # first q tile whose last global row reaches this k tile's first row
-    start = (jnp.clip((goff_k + k_off - goff_q) // bq, 0, n_q)
-             if causal else 0)
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
-               sm_scale, causal, block_k, has_seg, dropout_rate,
+               sm_scale, causal, has_seg, dropout_rate,
                has_offsets, with_lse):
-    # q_ref/g_ref: [1, BQ, D] tile; k_ref/v_ref: [1, T, D] (resident);
-    # lse_ref/delta_ref: [1, 1, BQ]; optional glse [1, 1, BQ];
-    # output dq: [1, BQ, D].
+    # Streaming grid (bh, q-tile, k-tile): q_ref/g_ref [1, BQ, D] fixed
+    # per (bh, j); k_ref/v_ref [1, BK, D] = this step's tile;
+    # lse_ref/delta_ref [1, 1, BQ]; optional glse [1, 1, BQ]; output
+    # dq [1, BQ, D] written at the last k step; scratch dq accumulator.
     qseg_ref, kseg_ref, seed_ref, offs_ref, outs = _unpack_rest(
         rest, has_seg, dropout_rate, has_offsets)
     if with_lse:
-        glse_ref, dq_ref = outs
+        glse_ref, dq_ref, dq_s = outs
     else:
         glse_ref = None
-        (dq_ref,) = outs
+        dq_ref, dq_s = outs
 
     q = q_ref[0]
     g = g_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
-    t = k_ref.shape[1]
     bq = q.shape[0]
-    d = q.shape[1]
-    bk = block_k
+    bk = k.shape[0]
+    kk = pl.program_id(2)
+    n_k = pl.num_programs(2)
     q_off = pl.program_id(1) * bq
+    k_off = kk * bk
     bh_idx = pl.program_id(0)
     seed = seed_ref[0, 0].astype(jnp.uint32) if seed_ref is not None else None
     goff_q = offs_ref[0, 0] if has_offsets else 0
     goff_k = offs_ref[0, 1] if has_offsets else 0
-    q_pos = goff_q + q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    seg_q = qseg_ref[0, 0] if has_seg else None
-    glse = glse_ref[0, 0] if with_lse else None
 
-    def body(j, dq):
-        k = k_ref[0, pl.dslice(j * bk, bk), :]
-        v = v_ref[0, pl.dslice(j * bk, bk), :]
+    @pl.when(kk == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    run = ((goff_q + q_off + bq - 1 >= goff_k + k_off)
+           if causal else (kk >= 0))
+
+    @pl.when(run)
+    def _tile():
+        q_pos = goff_q + q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        k_pos = goff_k + k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        k_pos = goff_k + j * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 1)
-        seg_k = kseg_ref[0, 0, pl.dslice(j * bk, bk)] if has_seg else None
+        seg_q = qseg_ref[0, 0] if has_seg else None
+        seg_k = kseg_ref[0, 0] if has_seg else None
         mask = _mask_tile(causal, q_pos, k_pos, seg_q, seg_k)
         a = jnp.exp(s - lse[:, None])
         if mask is not None:
@@ -396,18 +443,14 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
             da = dp
         ds = a * (da - delta[:, None]) * sm_scale
         if with_lse:
-            ds = ds + a * glse[:, None] * sm_scale
-        return dq + jax.lax.dot_general(
+            ds = ds + a * glse_ref[0, 0][:, None] * sm_scale
+        dq_s[...] = dq_s[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    n_k = t // bk
-    if causal:
-        n_k = jnp.minimum(n_k, jnp.maximum(
-            0, (goff_q + q_off + bq - 1 - goff_k) // bk + 1))
-    dq0 = jnp.zeros((bq, d), jnp.float32)
-    dq = jax.lax.fori_loop(0, n_k, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
@@ -430,77 +473,88 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     with_lse = g_lse is not None
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     shape = lambda s, dt: _shape_like(qf, s, dt)
-    full = lambda: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **kw)
-    vec_full = lambda: pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0), **kw)
-    seg_specs = lambda qs, ks: [
-        pl.BlockSpec(qs, (lambda i, j: (i // h, 0, 0)) if qs[2] == t
-                     else (lambda i, j: (i // h, 0, j)), **kw),
-        pl.BlockSpec(ks, (lambda i, j: (i // h, 0, 0)) if ks[2] == t
-                     else (lambda i, j: (i // h, 0, j)), **kw)]
     seed_in = ([] if dropout_rate == 0.0 else [seed.reshape(1, 1)])
     seed_spec = ([] if dropout_rate == 0.0 else
-                 [pl.BlockSpec((1, 1), lambda i, j: (0, 0), **kw)])
+                 [pl.BlockSpec((1, 1), lambda i, j, kk: (0, 0), **kw)])
     offs_in = ([offs.reshape(1, 2)] if has_offsets else [])
-    offs_spec = ([pl.BlockSpec((1, 2), lambda i, j: (0, 0), **kw)]
+    offs_spec = ([pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0), **kw)]
                  if has_offsets else [])
 
+    # dk/dv: grid (bh, k-tile, q-tile) — q/g/lse/delta stream over the
+    # minor q dimension; the k/v tile and the scratch accumulators are
+    # fixed per (bh, k-tile)
     dkv_kern = functools.partial(
-        _dkv_kernel, sm_scale=scale, causal=causal, block_q=bq,
+        _dkv_kernel, sm_scale=scale, causal=causal,
         has_seg=has_seg, dropout_rate=dropout_rate,
         has_offsets=has_offsets, with_lse=with_lse)
+    q_tile = lambda: pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0),
+                                  **kw)
+    vec_q = lambda: pl.BlockSpec((1, 1, bq), lambda i, j, qq: (i, 0, qq),
+                                 **kw)
     ins = [qf, gf, kf, vf, lse, delta]
-    in_specs = [full(), full(),
-                pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
-                pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
-                vec_full(), vec_full()]
+    in_specs = [q_tile(), q_tile(),
+                pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
+                pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
+                vec_q(), vec_q()]
     if has_seg:
         ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
-        in_specs += seg_specs((1, 1, t), (1, 1, bk))
+        in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda i, j, qq: (i // h, 0, qq), **kw),
+            pl.BlockSpec((1, 1, bk), lambda i, j, qq: (i // h, 0, j), **kw)]
     ins += seed_in
     in_specs += seed_spec
     ins += offs_in
     in_specs += offs_spec
     if with_lse:
         ins.append(g_lse)
-        in_specs.append(vec_full())
+        in_specs.append(vec_q())
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid=(b * h, t // bk),
+        grid=(b * h, t // bk, t // bq),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw),
-                   pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0), **kw)],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw)],
         out_shape=[shape((b * h, t, d), k.dtype),
                    shape((b * h, t, d), v.dtype)],
+        scratch_shapes=_scratch([((bk, d), jnp.float32),
+                                 ((bk, d), jnp.float32)]),
         interpret=interpret,
     )(*ins)
 
+    # dq: grid (bh, q-tile, k-tile) — k/v stream over the minor k
+    # dimension; the q/g/lse/delta tiles and the dq scratch are fixed
     dq_kern = functools.partial(
-        _dq_kernel, sm_scale=scale, causal=causal, block_k=bk,
+        _dq_kernel, sm_scale=scale, causal=causal,
         has_seg=has_seg, dropout_rate=dropout_rate,
         has_offsets=has_offsets, with_lse=with_lse)
+    vec_j = lambda: pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i, 0, j),
+                                 **kw)
     ins = [qf, gf, kf, vf, lse, delta]
-    in_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-                pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
-                full(), full(),
-                pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw),
-                pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j), **kw)]
+    in_specs = [pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
+                pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
+                pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
+                pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
+                vec_j(), vec_j()]
     if has_seg:
         ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
-        in_specs += seg_specs((1, 1, bq), (1, 1, t))
+        in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i // h, 0, j), **kw),
+            pl.BlockSpec((1, 1, bk), lambda i, j, kk: (i // h, 0, kk), **kw)]
     ins += seed_in
     in_specs += seed_spec
     ins += offs_in
     in_specs += offs_spec
     if with_lse:
         ins.append(g_lse)
-        in_specs.append(pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j),
-                                     **kw))
+        in_specs.append(vec_j())
     dq = pl.pallas_call(
         dq_kern,
-        grid=(b * h, t // bq),
+        grid=(b * h, t // bq, t // bk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), **kw),
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
         out_shape=shape((b * h, t, d), q.dtype),
+        scratch_shapes=_scratch([((bq, d), jnp.float32)]),
         interpret=interpret,
     )(*ins)
 
@@ -639,9 +693,32 @@ def _flash_bwd(dropout_rate, causal, sm_scale, block_q, block_k, bwd_impl,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(t: int, requested: Optional[int], default: int) -> int:
+    """Resolve a block size.  Explicit sizes are strict (must divide T, as
+    before); the default auto-shrinks by halving until it divides — so the
+    larger shipped default never rejects a T an older default accepted."""
+    if requested is not None:
+        b = min(int(requested), t)
+        if t % b:
+            raise ValueError(
+                f"flash_attention needs seq len ({t}) divisible by its "
+                f"tiles ({b}); pad the sequence or pass smaller block "
+                f"sizes")
+        return b
+    b = min(default, t)
+    while b > 1 and t % b:
+        b //= 2
+    if t % b:
+        raise ValueError(
+            f"flash_attention cannot tile seq len {t}; pass block_q/"
+            f"block_k that divide it (or pad the sequence)")
+    return b
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     *, q_segment_ids=None, kv_segment_ids=None,
                     dropout_rate: float = 0.0, dropout_seed=None,
                     q_offset=None, kv_offset=None,
@@ -652,8 +729,10 @@ def flash_attention(q, k, v, causal: bool = False,
     Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
     signature minus offsets); pass as ``attn_fn=`` to
     ``ulysses_attention`` for a fused inner kernel.  ``block_q``/
-    ``block_k`` tune the tile sizes (sequence length must be a multiple
-    of each, or fit a single tile).
+    ``block_k`` tune the tile sizes: explicit values must divide the
+    sequence length (or cover it in one tile); the default (1024, the
+    measured v5e optimum) auto-halves until it divides, so any T a
+    smaller default accepted still works.
 
     Extra keyword-only features:
 
@@ -696,9 +775,12 @@ def flash_attention(q, k, v, causal: bool = False,
             jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32)])
     else:
         offs = None
+    t = q.shape[1]
+    bq = _fit_block(t, block_q, _BLOCK_Q)
+    bk = _fit_block(t, block_k, _BLOCK_K)
     return _flash(q, k, v, q_segment_ids, kv_segment_ids, dropout_seed,
-                  offs, dropout_rate, bool(causal), sm_scale, int(block_q),
-                  int(block_k), bwd_impl, bool(return_lse))
+                  offs, dropout_rate, bool(causal), sm_scale, bq, bk,
+                  bwd_impl, bool(return_lse))
 
 
 __all__ = ["flash_attention"]

@@ -251,7 +251,7 @@ def test_rejects_indivisible_sequence():
     # T <= block size runs as one tile (any T); T > block size must divide
     x = jnp.asarray(rng.randn(1, 300, 2, 32), jnp.float32)
     with pytest.raises(ValueError, match="divisible"):
-        flash_attention(x, x, x, False)
+        flash_attention(x, x, x, False, block_q=256, block_k=256)
 
 
 def test_as_ulysses_inner_kernel(devices):
@@ -301,3 +301,20 @@ def test_asymmetric_blocks_with_offsets():
     np.testing.assert_allclose(
         np.asarray(jax.grad(loss)(q)), np.asarray(jax.grad(loss_ref)(q)),
         rtol=2e-3, atol=2e-3)
+
+
+def test_default_blocks_auto_fit_any_old_t():
+    """The 1024 default block (round 3) auto-halves until it divides T, so
+    sequences the old 256 default accepted keep working without args."""
+    from chainermn_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(1536, None, 1024) == 512
+    assert _fit_block(4864, None, 1024) == 256
+    assert _fit_block(300, None, 1024) == 300   # single tile
+    assert _fit_block(8192, None, 1024) == 1024
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randn(1, 1536, 2, 16), jnp.float32) * 0.3
+    out = flash_attention(x, x, x, True)
+    ref = attention(x, x, x, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
