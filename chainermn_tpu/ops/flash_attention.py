@@ -730,9 +730,11 @@ def flash_attention(q, k, v, causal: bool = False,
     signature minus offsets); pass as ``attn_fn=`` to
     ``ulysses_attention`` for a fused inner kernel.  ``block_q``/
     ``block_k`` tune the tile sizes: explicit values must divide the
-    sequence length (or cover it in one tile); the default (1024, the
-    measured v5e optimum) auto-halves until it divides, so any T a
-    smaller default accepted still works.
+    sequence length (or cover it in one tile); the default is
+    dtype-aware (1024 for sub-4-byte q/k/v — the measured v5e optimum —
+    and 512 when any operand is f32, whose tiles would overflow the
+    backward's VMEM budget at 1024) and auto-halves until it divides,
+    so any T a smaller default accepted still works.
 
     Extra keyword-only features:
 
@@ -776,8 +778,16 @@ def flash_attention(q, k, v, causal: bool = False,
     else:
         offs = None
     t = q.shape[1]
-    bq = _fit_block(t, block_q, _BLOCK_Q)
-    bk = _fit_block(t, block_k, _BLOCK_K)
+    # default blocks are dtype-aware: 1024x1024 is the measured bf16
+    # optimum, but f32 tiles double every VMEM buffer and the backward's
+    # scoped allocation overflows the 16 MB budget — 512 fits with room
+    # (widest of q/k/v decides: any f32 operand inflates the tiles)
+    if max(jnp.dtype(a.dtype).itemsize for a in (q, k, v)) >= 4:
+        dq_def, dk_def = min(_BLOCK_Q, 512), min(_BLOCK_K, 512)
+    else:
+        dq_def, dk_def = _BLOCK_Q, _BLOCK_K
+    bq = _fit_block(t, block_q, dq_def)
+    bk = _fit_block(t, block_k, dk_def)
     return _flash(q, k, v, q_segment_ids, kv_segment_ids, dropout_seed,
                   offs, dropout_rate, bool(causal), sm_scale, bq, bk,
                   bwd_impl, bool(return_lse))
