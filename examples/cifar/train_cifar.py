@@ -56,6 +56,9 @@ def main():
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
+    # multi-controller bootstrap from the CHAINERMN_TPU_* env contract
+    # (the reference's mpiexec launch shape); no-op single-controller
+    chainermn_tpu.init_distributed()
     comm = chainermn_tpu.create_communicator(
         args.communicator, intra_size=args.intra_size,
         allreduce_grad_dtype=args.allreduce_grad_dtype)

@@ -90,6 +90,9 @@ def main():
     if (args.val_src is None) != (args.val_tgt is None):
         p.error("--val-src and --val-tgt must be given together")
 
+    # multi-controller bootstrap from the CHAINERMN_TPU_* env contract
+    # (the reference's mpiexec launch shape); no-op single-controller
+    chainermn_tpu.init_distributed()
     comm = chainermn_tpu.create_communicator(args.communicator)
     rank0 = comm.rank == 0
 
@@ -189,35 +192,47 @@ def main():
     nv = 0
     hyps, refs = [], []
     multi_controller = getattr(comm, "host_size", 1) > 1
+    enc_owner = model.stage_owner(0)
+    dec_owner = model.stage_owner(1)
+    # Object-plane tag for the eval-time carry transfer — above the packed
+    # tag namespace cross-process chain lists reserve (32 instances << 15),
+    # so it can never collide with a chain's activation payloads.
+    CARRY_TAG = 33 << 15
     for batch in bucket_batches(val, args.batchsize, step=args.bucket_step,
                                 shuffle=False, drop_remainder=False):
         loss, acc = loss_fn(params, batch)
-        if not model.owns_output:
-            continue  # this process saw the pseudo-loss, not the metric
-        va_loss += float(loss)
-        va_acc += float(acc)
-        nv += 1
-        if multi_controller:
-            # greedy decode calls each stage's module directly; remote
-            # stage params are not materialized on this process
-            continue
-        carry = encoder.apply(params[0], batch["src"], batch["src_len"])
-        # the carry comes off stage 0's devices; move it to stage 1's
-        # before decoding against the decoder's (stage-1-placed) params
-        carry = model.place_activation(carry, 1)
-        toks = decoder.apply(params[1], carry, batch["tgt_out"].shape[1],
-                             method="decode", bos_id=BOS_ID)
-        toks = np.asarray(toks)[:batch["n_real"]]
-        for h_ids, r_ids in zip(toks, batch["tgt_out"][:batch["n_real"]]):
-            hyps.append(tgt_vocab.decode(h_ids))
-            refs.append(tgt_vocab.decode(r_ids))
+        if model.owns_output:
+            va_loss += float(loss)
+            va_acc += float(acc)
+            nv += 1
+        # Greedy decode for BLEU.  Cross-controller chains ship the carry
+        # once over the host-level object plane (eval only — no gradients
+        # needed, so the DCN autograd channels stay out of it).
+        carry = None
+        if model.is_local_stage(0):
+            carry = encoder.apply(params[0], batch["src"], batch["src_len"])
+            if multi_controller and dec_owner != enc_owner:
+                comm.send_obj(jax.device_get(carry), dec_owner,
+                              tag=CARRY_TAG)
+        if model.is_local_stage(1):
+            if multi_controller and dec_owner != enc_owner:
+                carry = comm.recv_obj(enc_owner, tag=CARRY_TAG)
+            # the carry comes off stage 0's devices (or the wire as numpy);
+            # place it on stage 1's group for the decoder's params —
+            # place_activation takes numpy leaves directly, one copy total
+            carry = model.place_activation(carry, 1)
+            toks = decoder.apply(params[1], carry,
+                                 batch["tgt_out"].shape[1],
+                                 method="decode", bos_id=BOS_ID)
+            toks = np.asarray(toks)[:batch["n_real"]]
+            for h_ids, r_ids in zip(toks,
+                                    batch["tgt_out"][:batch["n_real"]]):
+                hyps.append(tgt_vocab.decode(h_ids))
+                refs.append(tgt_vocab.decode(r_ids))
     result = {"val_loss": round(va_loss / max(nv, 1), 4),
               "val_token_accuracy": round(va_acc / max(nv, 1), 4)}
     if hyps:
         result["val_bleu"] = round(bleu(hyps, refs), 4)
-    elif rank0 and multi_controller:
-        print("(BLEU skipped: greedy decode needs both stages' params "
-              "in one process)")
     # in multi-controller mode only the exit-stage owner saw real metrics
     if model.owns_output:
         print(f"final: {result}")
