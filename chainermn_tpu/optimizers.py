@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from chainermn_tpu.communicators import _packing
 from chainermn_tpu.utils import pvary
 
 
@@ -107,14 +108,127 @@ class _DoubleBufferingOptimizer:
 _VARYING = "__varying__"
 
 
+class _ZeroState(NamedTuple):
+    inner: Any  # inner optax state over THIS device's flat shard (varying)
+
+
+class _Zero1Optimizer:
+    """ZeRO stage-1 optimizer-state sharding — **beyond-reference
+    extension** (the reference had nothing like it; clearly labeled, like
+    the other `parallel/` extensions).
+
+    Each device owns 1/size of the flattened parameter space: gradients
+    arrive via ``reduce_scatter`` (mean) as this device's shard, the inner
+    optax update runs on the shard only — so optimizer state (e.g. Adam's
+    m/v, 2x params) is divided by the world size — and the resulting
+    update shards ``all_gather`` back to the full parameter vector, which
+    stays replicated (stage 1: state sharded, params/grads not).
+
+    Wire cost per step: the reduce-scatter leg is half a ring allreduce;
+    the gather-back is a masked psum (~2x a ring gather's bytes — the
+    price of an invariant-typed result, see the inline comment), so the
+    total is ~1.5x one ring allreduce on the cheap ICI resource, while
+    per-device optimizer memory drops by ~size.  The communicator's
+    ``allreduce_grad_dtype`` (when set) applies to the reduce-scatter leg
+    exactly as it applies to ``allreduce_grad``: cast in, reduce in the
+    wire dtype, cast back before the inner update.  Inner optimizers
+    whose ``init`` depends on parameter VALUES (not just shapes/dtypes)
+    are unsupported — every standard optax rule
+    (sgd/momentum/adam/adamw/...) initializes from shapes.
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+        self.actual_optimizer = actual_optimizer
+        self.communicator = comm
+
+    def _shard_zeros(self, params):
+        """Zero-filled flat shards shaped like one device's slice —
+        computed from leaf shapes alone (no transient full flat copy;
+        mirrors _packing.pack's by-dtype grouping)."""
+        size = self.communicator.size
+        groups: dict = {}
+        for leaf in jax.tree.leaves(params):
+            key = str(leaf.dtype)
+            n = 1
+            for d in leaf.shape:
+                n *= int(d)
+            groups[key] = (groups.get(key, (0, leaf.dtype))[0] + n,
+                           leaf.dtype)
+        return [jnp.zeros(((n + (-n) % size) // size,), dt)
+                for n, dt in groups.values()]
+
+    def init(self, params):
+        return _ZeroState(
+            inner=self.actual_optimizer.init(self._shard_zeros(params)))
+
+    def update(self, grads, state, params=None, **kwargs):
+        comm = self.communicator
+        size = comm.size
+        idx = comm.axis_index()
+        # honor the communicator's wire dtype (the pure_nccl fp16/bf16
+        # recipe): cast in, reduce in the wire dtype, cast back — same
+        # numerics as allreduce_grad's cast-allreduce-cast path
+        wire_dtype = getattr(comm, "allreduce_grad_dtype", None)
+        g_bufs, meta = _packing.pack(grads)
+        p_bufs, _ = _packing.pack(params) if params is not None else (
+            [None] * len(g_bufs), None)
+        orig_lens = [g.shape[0] for g in g_bufs]
+        g_shards, p_shards = [], []
+        for g, p in zip(g_bufs, p_bufs):
+            g, _ = _packing.pad_to_multiple(g, size)
+            orig_dtype = g.dtype
+            if wire_dtype is not None and g.dtype != wire_dtype:
+                g = g.astype(wire_dtype)
+            # reduce_scatter sums; the reference's allreduce_grad is a mean
+            gs = comm.reduce_scatter(g) / size
+            g_shards.append(gs.astype(orig_dtype))
+            if p is not None:
+                p, _ = _packing.pad_to_multiple(p, size)
+                p_shards.append(
+                    jax.lax.dynamic_index_in_dim(
+                        p.reshape(size, -1), idx, axis=0, keepdims=False))
+        updates_sh, inner = self.actual_optimizer.update(
+            g_shards, state.inner,
+            p_shards if params is not None else None, **kwargs)
+        # Gather-back as a masked psum rather than all_gather: value-
+        # identical, but psum output is INVARIANT in JAX's varying-axes
+        # type system, so the updated parameters keep their replicated
+        # out_spec (same trick as the two_dimensional communicator's
+        # gather-back leg; ~2x the bytes of a ring gather on the cheap
+        # ICI resource).
+        upd_bufs = []
+        for u, n in zip(updates_sh, orig_lens):
+            placed = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros((u.shape[0] * size,), u.dtype), u,
+                idx * u.shape[0], 0)
+            upd_bufs.append(comm.allreduce(placed, "sum")[:n])
+        return _packing.unpack(upd_bufs, meta), _ZeroState(inner=inner)
+
+    def state_partition_spec(self):
+        # the whole inner state lives on per-device shards
+        return _ZeroState(inner=_VARYING)
+
+
 def create_multi_node_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator,
     double_buffering: bool = False,
+    zero: bool = False,
 ):
     """Reference signature: ``create_multi_node_optimizer(optimizer, comm,
     double_buffering)`` 〔optimizers.py〕.  ``actual_optimizer`` is an optax
-    GradientTransformation (the Chainer-optimizer role)."""
+    GradientTransformation (the Chainer-optimizer role).
+
+    ``zero=True`` (beyond-reference extension) shards the optimizer state
+    ZeRO-1-style over the communicator's devices — see
+    :class:`_Zero1Optimizer`.  Mutually exclusive with ``double_buffering``
+    (the pending-gradient buffer would defeat the memory saving)."""
+    if zero and double_buffering:
+        raise ValueError("zero=True and double_buffering=True are mutually "
+                         "exclusive (the pending full-size gradient buffer "
+                         "would defeat ZeRO's memory saving)")
+    if zero:
+        return _Zero1Optimizer(actual_optimizer, communicator)
     if double_buffering:
         return _DoubleBufferingOptimizer(actual_optimizer, communicator)
     return _MultiNodeOptimizer(actual_optimizer, communicator)
@@ -171,6 +285,10 @@ def make_train_step(
             opt_state = opt_state._replace(
                 pending=jax.tree.map(lambda a: jnp.squeeze(a, 0),
                                      opt_state.pending))
+        if isinstance(opt_state, _ZeroState):
+            # stacked per-device shard states arrive as [1, ...] slices
+            opt_state = _ZeroState(inner=jax.tree.map(
+                lambda a: jnp.squeeze(a, 0), opt_state.inner))
         if with_model_state:
             model_state = jax.tree.map(lambda a: jnp.squeeze(a, 0), model_state)
         # Mark the replicated params device-varying for the local backward:
@@ -207,6 +325,9 @@ def make_train_step(
                 aux, anchor = jax.lax.optimization_barrier((aux, anchor))
             opt_state = opt_state._replace(
                 pending=jax.tree.map(lambda a: a[None], opt_state.pending))
+        if isinstance(opt_state, _ZeroState):
+            opt_state = _ZeroState(inner=jax.tree.map(
+                lambda a: a[None], opt_state.inner))
         if with_model_state:
             model_state = jax.tree.map(lambda a: a[None], model_state)
         loss = comm.allreduce(loss, "mean")
@@ -290,6 +411,14 @@ def init_opt_state(communicator, optimizer, params):
     (leading axis == communicator.size) sharded over the data axes."""
     comm = communicator
     state = optimizer.init(params)
+    if isinstance(state, _ZeroState):
+        # every device's shard state starts as identical zeros; stack to
+        # the device-local layout ([size, ...] sharded over the data axes)
+        stacked = jax.tree.map(
+            lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape),
+            state.inner)
+        return _ZeroState(inner=jax.device_put(
+            stacked, NamedSharding(comm.mesh, P(comm.data_axes))))
     if not isinstance(state, _DoubleBufferState):
         return jax.device_put(state, NamedSharding(comm.mesh, P()))
     stacked_pending = jax.tree.map(
