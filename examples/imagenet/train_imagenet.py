@@ -70,6 +70,9 @@ def main():
     parser.add_argument("--communicator", default="xla")
     parser.add_argument("--allreduce-grad-dtype", default=None)
     parser.add_argument("--double-buffering", action="store_true")
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1 optimizer-state sharding (extension; "
+                             "exclusive with --double-buffering)")
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--n-classes", type=int, default=1000)
     parser.add_argument("--train-size", type=int, default=4096,
@@ -113,6 +116,8 @@ def main():
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
+    if args.zero and args.double_buffering:
+        parser.error("--zero and --double-buffering are mutually exclusive")
 
     # multi-controller bootstrap from the CHAINERMN_TPU_* env contract
     # (the reference's mpiexec launch shape); no-op single-controller
@@ -209,7 +214,7 @@ def main():
     params = comm.bcast_data(variables["params"])
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.sgd(args.lr, momentum=0.9), comm,
-        double_buffering=args.double_buffering)
+        double_buffering=args.double_buffering, zero=args.zero)
     opt_state = init_opt_state(comm, optimizer, params)
 
     model_state = (init_model_state(comm, variables["batch_stats"])

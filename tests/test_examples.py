@@ -169,3 +169,14 @@ def test_imagenet_checkpoint_resume(tmp_path):
     # B2 only ran epoch 2; its final row must equal run A's epoch-2 row
     assert last_val_loss(out_b2) == pytest.approx(last_val_loss(out_a),
                                                   rel=1e-5)
+
+
+@pytest.mark.slow
+def test_imagenet_zero_optimizer(tmp_path):
+    """--zero trains the ImageNet script with ZeRO-1 state sharding."""
+    out = _run("imagenet/train_imagenet.py",
+               "--arch", "nin", "--epoch", "1", "--batchsize", "16",
+               "--train-size", "64", "--image-size", "64",
+               "--n-classes", "10", "--dtype", "float32", "--zero",
+               "--out", str(tmp_path))
+    assert "loss" in out.lower() or "epoch" in out.lower()
