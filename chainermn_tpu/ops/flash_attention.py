@@ -230,17 +230,19 @@ def _scratch(shapes_dtypes):
 
 def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
              block_k, dropout_rate, interpret):
-    b, t, h, d = q.shape
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    bq = min(block_q, t)
-    bk = min(block_k, t)
-    if t % bq or t % bk:
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    if tq % bq or tk % bk:
         raise ValueError(
-            f"flash_attention needs seq len ({t}) divisible by its tiles "
-            f"({bq}, {bk}); pad the sequence or pass smaller block sizes")
+            f"flash_attention needs seq lens ({tq}, {tk}) divisible by "
+            f"their tiles ({bq}, {bk}); pad the sequence or pass smaller "
+            f"block sizes")
     # [B, T, H, D] -> [B*H, T, D]
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     qf, kf, vf = fold(q), fold(k), fold(v)
     has_seg = qseg is not None
     has_offsets = offs is not None
@@ -260,7 +262,7 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
         # TPU tiling wants the last two block dims divisible by (8, 128) or
         # equal to the array dims — a singleton row dim satisfies that, so
         # host-side vectors ride as [*, 1, T].
-        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
+        ins += [qseg.reshape(b, 1, tq), kseg.reshape(b, 1, tk)]
         in_specs += [
             pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i // h, 0, j), **kw),
             pl.BlockSpec((1, 1, bk), lambda i, j, kk: (i // h, 0, kk), **kw),
@@ -273,11 +275,11 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
         in_specs.append(pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0), **kw))
     # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
-    out_shape = [_shape_like(qf, (b * h, t, d), q.dtype),
-                 _shape_like(qf, (b * h, 1, t), jnp.float32)]
+    out_shape = [_shape_like(qf, (b * h, tq, d), q.dtype),
+                 _shape_like(qf, (b * h, 1, tq), jnp.float32)]
     out, lse = pl.pallas_call(
         kern,
-        grid=(b * h, t // bq, t // bk),
+        grid=(b * h, tq // bq, tk // bk),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
@@ -288,7 +290,7 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
                                  ((bq, 1), jnp.float32)]),
         interpret=interpret,
     )(*ins)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3), lse
 
 
 # ---------------------------------------------------------------------------
@@ -456,12 +458,13 @@ def _dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref, *rest,
 def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                      causal, sm_scale, block_q, block_k, dropout_rate,
                      interpret):
-    b, t, h, d = q.shape
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(out), fold(g)
     # delta = rowsum(dO * O): cheap fused elementwise+reduce, XLA's job.
     # lse arrives as [B*H, 1, T] (see _forward's tiling note); delta gets
@@ -497,7 +500,7 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                 pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
                 vec_q(), vec_q()]
     if has_seg:
-        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
+        ins += [qseg.reshape(b, 1, tq), kseg.reshape(b, 1, tk)]
         in_specs += [
             pl.BlockSpec((1, 1, bq), lambda i, j, qq: (i // h, 0, qq), **kw),
             pl.BlockSpec((1, 1, bk), lambda i, j, qq: (i // h, 0, j), **kw)]
@@ -510,13 +513,13 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
         in_specs.append(vec_q())
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid=(b * h, t // bk, t // bq),
+        grid=(b * h, tk // bk, tq // bq),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw)],
-        out_shape=[shape((b * h, t, d), k.dtype),
-                   shape((b * h, t, d), v.dtype)],
+        out_shape=[shape((b * h, tk, d), k.dtype),
+                   shape((b * h, tk, d), v.dtype)],
         scratch_shapes=_scratch([((bk, d), jnp.float32),
                                  ((bk, d), jnp.float32)]),
         interpret=interpret,
@@ -537,7 +540,7 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                 pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
                 vec_j(), vec_j()]
     if has_seg:
-        ins += [qseg.reshape(b, 1, t), kseg.reshape(b, 1, t)]
+        ins += [qseg.reshape(b, 1, tq), kseg.reshape(b, 1, tk)]
         in_specs += [
             pl.BlockSpec((1, 1, bq), lambda i, j, kk: (i // h, 0, j), **kw),
             pl.BlockSpec((1, 1, bk), lambda i, j, kk: (i // h, 0, kk), **kw)]
@@ -550,16 +553,16 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
         in_specs.append(vec_j())
     dq = pl.pallas_call(
         dq_kern,
-        grid=(b * h, t // bq, t // bk),
+        grid=(b * h, tq // bq, tk // bk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
-        out_shape=shape((b * h, t, d), q.dtype),
+        out_shape=shape((b * h, tq, d), q.dtype),
         scratch_shapes=_scratch([((bq, d), jnp.float32)]),
         interpret=interpret,
     )(*ins)
 
-    unfold = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return unfold(dq), unfold(dk), unfold(dv)
+    unfold = lambda x, t_: x.reshape(b, h, t_, d).transpose(0, 2, 1, 3)
+    return unfold(dq, tq), unfold(dk, tk), unfold(dv, tk)
 
 
 def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
@@ -570,18 +573,19 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     hash-based dropout mask), expressed as a `lax.scan` over K/V tiles so
     the [T, T] matrix is still never materialized.
     """
-    b, t, h, d = q.shape
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    bk = min(block_k, t)
-    n = t // bk
+    bk = min(block_k, tk)
+    n = tk // bk
     # [B, T, H, D] -> [B, H, T, D] f32 working layout
     tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
     qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
-    lseT = lse.reshape(b, h, t)  # lse arrives [B*H, 1, T]
-    glseT = g_lse.reshape(b, h, t) if g_lse is not None else None
+    lseT = lse.reshape(b, h, tq)  # lse arrives [B*H, 1, Tq]
+    glseT = g_lse.reshape(b, h, tq) if g_lse is not None else None
     goff_q = offs[0] if offs is not None else 0
     goff_k = offs[1] if offs is not None else 0
-    q_pos = goff_q + jnp.arange(t)
+    q_pos = goff_q + jnp.arange(tq)
     bh_idx = jnp.arange(b * h).reshape(b, h, 1, 1)
     D = (gT * oT).sum(-1)                                  # [B, H, T]
     inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
@@ -631,8 +635,8 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
 
     dq0 = jnp.zeros_like(qT)
     dq, (dk_tiles, dv_tiles) = jax.lax.scan(grad_fold, dq0, jnp.arange(n))
-    # [n, B, H, bk, D] -> [B, H, T, D]
-    merge = lambda tiles: tiles.transpose(1, 2, 0, 3, 4).reshape(b, h, t, d)
+    # [n, B, H, bk, D] -> [B, H, Tk, D]
+    merge = lambda tiles: tiles.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
     back = lambda x, ref: x.transpose(0, 2, 1, 3).astype(ref.dtype)
     return (back(dq, q), back(merge(dk_tiles), k), back(merge(dv_tiles), v))
 
@@ -724,7 +728,11 @@ def flash_attention(q, k, v, causal: bool = False,
                     q_offset=None, kv_offset=None,
                     return_lse: bool = False,
                     bwd_impl: str = "pallas"):
-    """Fused softmax attention: [B, T, H, D] q/k/v -> [B, T, H, D].
+    """Fused softmax attention: q [B, Tq, H, D], k/v [B, Tkv, H, D]
+    -> [B, Tq, H, D].  ``Tq != Tkv`` is supported (cross-attention /
+    decode-over-cache); with ``causal`` the mask compares GLOBAL
+    positions (row ``q_offset+i`` sees column ``kv_offset+j`` iff
+    ``i+q_offset >= j+kv_offset``).
 
     Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
     signature minus offsets); pass as ``attn_fn=`` to
@@ -777,7 +785,13 @@ def flash_attention(q, k, v, causal: bool = False,
             jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32)])
     else:
         offs = None
-    t = q.shape[1]
+    # cross-attention supported: Tq (from q) and Tkv (from k/v) may differ
+    if k.shape != v.shape:
+        raise ValueError(f"k and v shapes differ: {k.shape} vs {v.shape}")
+    if (q.shape[0], q.shape[2], q.shape[3]) != (
+            k.shape[0], k.shape[2], k.shape[3]):
+        raise ValueError(
+            f"q and k/v must share batch/heads/dim: {q.shape} vs {k.shape}")
     # default blocks are dtype-aware: 1024x1024 is the measured bf16
     # optimum, but f32 tiles double every VMEM buffer and the backward's
     # scoped allocation overflows the 16 MB budget — 512 fits with room
@@ -786,8 +800,8 @@ def flash_attention(q, k, v, causal: bool = False,
         dq_def, dk_def = min(_BLOCK_Q, 512), min(_BLOCK_K, 512)
     else:
         dq_def, dk_def = _BLOCK_Q, _BLOCK_K
-    bq = _fit_block(t, block_q, dq_def)
-    bk = _fit_block(t, block_k, dk_def)
+    bq = _fit_block(q.shape[1], block_q, dq_def)
+    bk = _fit_block(k.shape[1], block_k, dk_def)
     return _flash(q, k, v, q_segment_ids, kv_segment_ids, dropout_seed,
                   offs, dropout_rate, bool(causal), sm_scale, bq, bk,
                   bwd_impl, bool(return_lse))
