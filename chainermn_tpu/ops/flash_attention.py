@@ -231,7 +231,8 @@ def _scratch(shapes_dtypes):
 def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
              block_k, dropout_rate, interpret):
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hk = k.shape[1], k.shape[2]
+    grp = h // hk  # q heads per kv head (1 = MHA; >1 = GQA/MQA)
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bq = min(block_q, tq)
     bk = min(block_k, tk)
@@ -242,8 +243,11 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
             f"block sizes")
     # [B, T, H, D] -> [B*H, T, D]
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        return x.transpose(0, 2, 1, 3).reshape(-1, x.shape[1], d)
     qf, kf, vf = fold(q), fold(k), fold(v)
+    # grid dim 0 iterates q heads (b*h programs); a kv tensor row for
+    # program i is its (batch, kv-head) pair
+    kv_row = lambda i: (i // h) * hk + (i % h) // grp
     has_seg = qseg is not None
     has_offsets = offs is not None
 
@@ -254,8 +258,8 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
     ins = [qf, kf, vf]
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
-        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
-        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (kv_row(i), kk, 0), **kw),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (kv_row(i), kk, 0), **kw),
     ]
     if has_seg:
         # segment ids are per-batch; heads share them (index map i // h).
@@ -459,13 +463,15 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                      causal, sm_scale, block_q, block_k, dropout_rate,
                      interpret):
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hk = k.shape[1], k.shape[2]
+    grp = h // hk  # q heads per kv head (GQA); dk/dv computed per q head
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bq = min(block_q, tq)
     bk = min(block_k, tk)
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        return x.transpose(0, 2, 1, 3).reshape(-1, x.shape[1], d)
     qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(out), fold(g)
+    kv_row = lambda i: (i // h) * hk + (i % h) // grp
     # delta = rowsum(dO * O): cheap fused elementwise+reduce, XLA's job.
     # lse arrives as [B*H, 1, T] (see _forward's tiling note); delta gets
     # the same singleton-row layout.
@@ -496,8 +502,10 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                                  **kw)
     ins = [qf, gf, kf, vf, lse, delta]
     in_specs = [q_tile(), q_tile(),
-                pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
-                pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0), **kw),
+                pl.BlockSpec((1, bk, d),
+                             lambda i, j, qq: (kv_row(i), j, 0), **kw),
+                pl.BlockSpec((1, bk, d),
+                             lambda i, j, qq: (kv_row(i), j, 0), **kw),
                 vec_q(), vec_q()]
     if has_seg:
         ins += [qseg.reshape(b, 1, tq), kseg.reshape(b, 1, tk)]
@@ -524,6 +532,14 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
                                  ((bk, d), jnp.float32)]),
         interpret=interpret,
     )(*ins)
+    if grp > 1:
+        # each kv head's gradient is the sum over its q-head group —
+        # accumulated in f32 (the kernel's partials were cast to the
+        # output dtype; summing them in bf16 would compound rounding the
+        # blockwise oracle doesn't have)
+        group_sum = lambda x: x.astype(jnp.float32).reshape(
+            b, hk, grp, tk, d).sum(2).reshape(b * hk, tk, d).astype(x.dtype)
+        dk, dv = group_sum(dk), group_sum(dv)
 
     # dq: grid (bh, q-tile, k-tile) — k/v stream over the minor k
     # dimension; the q/g/lse/delta tiles and the dq scratch are fixed
@@ -536,8 +552,10 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     ins = [qf, gf, kf, vf, lse, delta]
     in_specs = [pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
                 pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0), **kw),
-                pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
-                pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0), **kw),
+                pl.BlockSpec((1, bk, d),
+                             lambda i, j, kk: (kv_row(i), kk, 0), **kw),
+                pl.BlockSpec((1, bk, d),
+                             lambda i, j, kk: (kv_row(i), kk, 0), **kw),
                 vec_j(), vec_j()]
     if has_seg:
         ins += [qseg.reshape(b, 1, tq), kseg.reshape(b, 1, tk)]
@@ -561,8 +579,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
         interpret=interpret,
     )(*ins)
 
-    unfold = lambda x, t_: x.reshape(b, h, t_, d).transpose(0, 2, 1, 3)
-    return unfold(dq, tq), unfold(dk, tk), unfold(dv, tk)
+    unfold = lambda x, t_, h_: x.reshape(b, h_, t_, d).transpose(0, 2, 1, 3)
+    return unfold(dq, tq, h), unfold(dk, tk, hk), unfold(dv, tk, hk)
 
 
 def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
@@ -574,13 +592,18 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     the [T, T] matrix is still never materialized.
     """
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hk = k.shape[1], k.shape[2]
+    grp = h // hk
     scale = sm_scale if sm_scale is not None else d ** -0.5
     bk = min(block_k, tk)
     n = tk // bk
     # [B, T, H, D] -> [B, H, T, D] f32 working layout
     tr = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
-    qT, kT, vT, oT, gT = tr(q), tr(k), tr(v), tr(out), tr(g)
+    qT, oT, gT = tr(q), tr(out), tr(g)
+    kT, vT = tr(k), tr(v)
+    if grp > 1:  # GQA: expand kv to one head per q head for the math
+        rep = lambda x: jnp.repeat(x, grp, axis=1)
+        kT, vT = rep(kT), rep(vT)
     lseT = lse.reshape(b, h, tq)  # lse arrives [B*H, 1, Tq]
     glseT = g_lse.reshape(b, h, tq) if g_lse is not None else None
     goff_q = offs[0] if offs is not None else 0
@@ -638,7 +661,11 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     # [n, B, H, bk, D] -> [B, H, Tk, D]
     merge = lambda tiles: tiles.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
     back = lambda x, ref: x.transpose(0, 2, 1, 3).astype(ref.dtype)
-    return (back(dq, q), back(merge(dk_tiles), k), back(merge(dv_tiles), v))
+    dk_full, dv_full = merge(dk_tiles), merge(dv_tiles)
+    if grp > 1:  # sum each kv head's gradient over its q-head group
+        gsum = lambda x: x.reshape(b, hk, grp, tk, d).sum(2)
+        dk_full, dv_full = gsum(dk_full), gsum(dv_full)
+    return (back(dq, q), back(dk_full, k), back(dv_full, v))
 
 
 # ---------------------------------------------------------------------------
@@ -728,11 +755,14 @@ def flash_attention(q, k, v, causal: bool = False,
                     q_offset=None, kv_offset=None,
                     return_lse: bool = False,
                     bwd_impl: str = "pallas"):
-    """Fused softmax attention: q [B, Tq, H, D], k/v [B, Tkv, H, D]
+    """Fused softmax attention: q [B, Tq, H, D], k/v [B, Tkv, Hkv, D]
     -> [B, Tq, H, D].  ``Tq != Tkv`` is supported (cross-attention /
     decode-over-cache); with ``causal`` the mask compares GLOBAL
     positions (row ``q_offset+i`` sees column ``kv_offset+j`` iff
-    ``i+q_offset >= j+kv_offset``).
+    ``i+q_offset >= j+kv_offset``).  ``Hkv`` may divide ``H``
+    (grouped-query / multi-query attention): each kv head serves
+    ``H/Hkv`` q heads — the kernels read the shared K/V tiles via index
+    maps (no materialized repeat) and dk/dv sum over each group.
 
     Drop-in for :func:`chainermn_tpu.parallel.sequence.attention` (same
     signature minus offsets); pass as ``attn_fn=`` to
@@ -785,13 +815,17 @@ def flash_attention(q, k, v, causal: bool = False,
             jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32)])
     else:
         offs = None
-    # cross-attention supported: Tq (from q) and Tkv (from k/v) may differ
+    # cross-attention supported: Tq (from q) and Tkv (from k/v) may
+    # differ; GQA/MQA supported: k/v head count may divide q's
     if k.shape != v.shape:
         raise ValueError(f"k and v shapes differ: {k.shape} vs {v.shape}")
-    if (q.shape[0], q.shape[2], q.shape[3]) != (
-            k.shape[0], k.shape[2], k.shape[3]):
+    if (q.shape[0], q.shape[3]) != (k.shape[0], k.shape[3]):
         raise ValueError(
-            f"q and k/v must share batch/heads/dim: {q.shape} vs {k.shape}")
+            f"q and k/v must share batch/dim: {q.shape} vs {k.shape}")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q head count ({q.shape[2]}) must be a multiple of the kv "
+            f"head count ({k.shape[2]}) for grouped-query attention")
     # default blocks are dtype-aware: 1024x1024 is the measured bf16
     # optimum, but f32 tiles double every VMEM buffer and the backward's
     # scoped allocation overflows the 16 MB budget — 512 fits with room

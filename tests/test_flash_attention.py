@@ -352,9 +352,87 @@ def test_cross_attention_rectangular(causal):
 def test_cross_attention_shape_validation():
     rng = np.random.RandomState(22)
     q = jnp.asarray(rng.randn(1, 128, 2, 32), jnp.float32)
-    k = jnp.asarray(rng.randn(1, 128, 4, 32), jnp.float32)  # head mismatch
-    with pytest.raises(ValueError, match="batch/heads/dim"):
+    # MORE kv heads than q heads is not a valid GQA grouping either
+    k = jnp.asarray(rng.randn(1, 128, 4, 32), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of the kv"):
         flash_attention(q, k, k, False)
+    d_mismatch = jnp.asarray(rng.randn(1, 128, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="batch/dim"):
+        flash_attention(q, d_mismatch, d_mismatch, False)
     v = jnp.asarray(rng.randn(1, 64, 2, 32), jnp.float32)
     with pytest.raises(ValueError, match="k and v"):
         flash_attention(q, q, v, False)
+
+
+@pytest.mark.parametrize("hk", [1, 2])
+def test_grouped_query_attention(hk):
+    """GQA/MQA (round 3): 4 q heads over hk kv heads, forward + both
+    backward impls vs the repeated-kv oracle (jnp.repeat's transpose sums
+    over the group — exactly the dk/dv group reduction)."""
+    rng = np.random.RandomState(31)
+    q = jnp.asarray(rng.randn(2, 256, 4, 32), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(2, 256, hk, 32), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(2, 256, hk, 32), jnp.float32) * 0.3
+    grp = 4 // hk
+
+    got = flash_attention(q, k, v, True, block_q=128, block_k=128)
+    want = attention(q, jnp.repeat(k, grp, 2), jnp.repeat(v, grp, 2),
+                     causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+    for impl in ("pallas", "blockwise"):
+        def loss(a, b_, c):
+            return (flash_attention(a, b_, c, True, block_q=128,
+                                    block_k=128, bwd_impl=impl) ** 2).sum()
+
+        def loss_ref(a, b_, c):
+            return (attention(a, jnp.repeat(b_, grp, 2),
+                              jnp.repeat(c, grp, 2), causal=True) ** 2).sum()
+
+        got_g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want_g = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got_g, want_g, "qkv"):
+            assert g.shape == w.shape
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"{impl} grad wrt {name}")
+
+
+def test_gqa_head_count_validation():
+    rng = np.random.RandomState(32)
+    q = jnp.asarray(rng.randn(1, 128, 4, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 128, 3, 32), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of the kv"):
+        flash_attention(q, k, k, False)
+
+
+def test_gqa_with_all_optional_features():
+    """GQA combined with dropout + segment ids + rectangular Tq/Tkv +
+    return_lse: pins the kv_row index maps against the optional-input
+    BlockSpec threading in every kernel (pallas vs blockwise parity)."""
+    rng = np.random.RandomState(33)
+    q = jnp.asarray(rng.randn(2, 128, 4, 32), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(2, 256, 2, 32), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(2, 256, 2, 32), jnp.float32) * 0.3
+    qseg = jnp.asarray(rng.randint(0, 2, size=(2, 128)), jnp.int32)
+    kseg = jnp.asarray(rng.randint(0, 2, size=(2, 256)), jnp.int32)
+
+    def loss(impl):
+        def f(a, b_, c):
+            out, lse = flash_attention(
+                a, b_, c, True, block_q=128, block_k=128,
+                q_segment_ids=qseg, kv_segment_ids=kseg,
+                dropout_rate=0.2, dropout_seed=11, q_offset=128,
+                return_lse=True, bwd_impl=impl)
+            lse_f = jnp.where(jnp.abs(lse) > 1e29, 0.0, lse)  # sentinel rows
+            return (out ** 2).sum() + 0.1 * (lse_f ** 2).sum()
+        return f
+
+    got = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss("blockwise"), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"grad wrt {name}")
