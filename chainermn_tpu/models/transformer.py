@@ -67,6 +67,7 @@ class Block(nn.Module):
     attention_impl: str = "xla"
     axis_name: Any = None
     dtype: Any = jnp.float32
+    n_kv_heads: Optional[int] = None  # < n_heads = GQA/MQA (flash impl)
     moe_experts: int = 0          # 0 = dense MLP
     moe_top_k: int = 1
     moe_axis: Any = "ep"
@@ -76,17 +77,30 @@ class Block(nn.Module):
     def __call__(self, x):
         d_model = x.shape[-1]
         head_dim = d_model // self.n_heads
+        n_kv = self.n_kv_heads or self.n_heads
         dense = lambda f, name: nn.Dense(
             f, dtype=self.dtype, param_dtype=jnp.float32, name=name)
         ln = lambda name: nn.LayerNorm(dtype=self.dtype,
                                        param_dtype=jnp.float32, name=name)
 
         h = ln("ln_attn")(x)
-        qkv = dense(3 * d_model, "qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = h.shape[:-1] + (self.n_heads, head_dim)
-        out = _attend(self.attention_impl, self.axis_name,
-                      q.reshape(shape), k.reshape(shape), v.reshape(shape),
+        d_kv = n_kv * head_dim
+        qkv = dense(d_model + 2 * d_kv, "qkv")(h)
+        q = qkv[..., :d_model]
+        k = qkv[..., d_model:d_model + d_kv]
+        v = qkv[..., d_model + d_kv:]
+        q = q.reshape(h.shape[:-1] + (self.n_heads, head_dim))
+        k = k.reshape(h.shape[:-1] + (n_kv, head_dim))
+        v = v.reshape(h.shape[:-1] + (n_kv, head_dim))
+        if n_kv != self.n_heads and self.attention_impl not in (
+                "flash", "ring_flash"):
+            # the fused kernel reads grouped kv natively (and under
+            # ring_flash the GROUPED blocks rotate the ring — 1/grp the
+            # ppermute bytes, GQA's whole point); other impls see the
+            # expanded heads
+            k = jnp.repeat(k, self.n_heads // n_kv, axis=-2)
+            v = jnp.repeat(v, self.n_heads // n_kv, axis=-2)
+        out = _attend(self.attention_impl, self.axis_name, q, k, v,
                       causal=True)
         x = x + dense(d_model, "proj")(out.reshape(h.shape))
 
@@ -124,6 +138,7 @@ class TransformerLM(nn.Module):
     attention_impl: str = "xla"
     axis_name: Any = None
     dtype: Any = jnp.float32
+    n_kv_heads: Optional[int] = None  # < n_heads = GQA/MQA
     moe_experts: int = 0          # >0: MoE MLP in every block (EP over moe_axis)
     moe_top_k: int = 1
     moe_axis: Any = "ep"
@@ -135,6 +150,11 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"n_heads ({self.n_heads}) must divide d_model "
                 f"({self.d_model})")
+        if self.n_kv_heads is not None and (
+                self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads):
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must be >= 1 and divide "
+                f"n_heads ({self.n_heads})")
         x = nn.Embed(self.vocab, self.d_model, param_dtype=jnp.float32,
                      dtype=self.dtype, name="tok_emb")(tokens)
         pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,
@@ -143,7 +163,8 @@ class TransformerLM(nn.Module):
         x = x + pos
         for i in range(self.n_layers):
             x = Block(self.n_heads, self.attention_impl, self.axis_name,
-                      self.dtype, moe_experts=self.moe_experts,
+                      self.dtype, n_kv_heads=self.n_kv_heads,
+                      moe_experts=self.moe_experts,
                       moe_top_k=self.moe_top_k, moe_axis=self.moe_axis,
                       moe_capacity=self.moe_capacity, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
