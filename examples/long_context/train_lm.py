@@ -50,9 +50,17 @@ def main():
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA/MQA: kv head count (must divide --heads; "
+                        "flash/ring_flash read grouped kv natively)")
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
+
+    if args.kv_heads is not None and (
+            args.kv_heads < 1 or args.heads % args.kv_heads):
+        p.error(f"--kv-heads ({args.kv_heads}) must be >= 1 and divide "
+                f"--heads ({args.heads})")
 
     devices = jax.devices()
     seq_parallel = args.attention in ("ring", "ring_flash", "ulysses")
@@ -64,12 +72,13 @@ def main():
 
     model = TransformerLM(
         vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
-        n_heads=args.heads, max_len=args.seq_len,
-        attention_impl=args.attention,
+        n_heads=args.heads, n_kv_heads=args.kv_heads,
+        max_len=args.seq_len, attention_impl=args.attention,
         axis_name="sp" if seq_parallel else None)
     ref_init = TransformerLM(
         vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
-        n_heads=args.heads, max_len=args.seq_len, attention_impl="xla")
+        n_heads=args.heads, n_kv_heads=args.kv_heads,
+        max_len=args.seq_len, attention_impl="xla")
 
     toks = make_motif_task(args.batchsize, args.seq_len, args.vocab,
                            seed=args.seed)
