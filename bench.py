@@ -39,14 +39,11 @@ TRAIN_GFLOP_PER_IMAGE = 12.3
 PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0,   # bf16 peak
                "tpu v4": 275.0, "tpu v6 lite": 918.0, "tpu v6e": 918.0}
 
-# Substrings identifying a transient tunnel/transport failure worth retrying
-# (lower-cased match against "TypeName: message").  The round-2 loss was
-# "remote_compile: response body closed before all bytes were read".
-TRANSIENT_MARKERS = (
-    "remote_compile", "read body", "closed before", "unavailable",
-    "deadline", "connection", "socket", "reset by peer", "broken pipe",
-    "eof", "timed out", "timeout", "internal: ", "transport",
-)
+# Transient-vs-deterministic failure classification and the bounded-retry
+# loop live in chainermn_tpu.utils.retry (shared with tools/tpu_smoke.py).
+# The round-2 loss was "remote_compile: response body closed before all
+# bytes were read".
+from chainermn_tpu.utils.retry import retry_transient  # noqa: E402
 
 
 def _peak_tflops(device) -> float:
@@ -76,9 +73,21 @@ def run(args) -> dict:
 
     on_tpu = jax.default_backend() == "tpu"
     n_dev = jax.device_count()
+    # Round-4 A/B on the chip (all four combinations, b=256): the s2d stem
+    # is a wash at this model (2374.9 vs 2382.9 img/s conv7 — the stem is
+    # only 1.6 ms of the 98 ms step) and scan>1 REGRESSES ~1.5x (conv7:
+    # 158.3 ms/step at scan=10 vs 107.4 at scan=1 — XLA's loop-invariant
+    # layout assignment forces default layouts on the conv weights inside
+    # the scan body).  Defaults therefore stay at the reference semantics;
+    # both knobs remain available for measurement.
+    stem = args.stem or "conv7"
+    scan = args.scan if args.scan else 1
+    if scan < 1:
+        raise SystemExit(f"--scan must be >= 1, got {scan}")
     if on_tpu:
         n_classes = 1000
-        model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16)
+        model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16,
+                         stem=stem)
         # b=256 won a 128/256/512 sweep (2472 vs 2427 vs 2393 img/s);
         # per-step time scales linearly with batch -> compute-bound.
         per_chip_batch, image, steps, warmup = 256, 224, 20, 5
@@ -86,13 +95,16 @@ def run(args) -> dict:
         n_classes = 10
         model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
                        num_filters=8, num_classes=n_classes,
-                       dtype=jnp.float32)
+                       dtype=jnp.float32, stem=stem)
         per_chip_batch, image, steps, warmup = 8, 32, 5, 2
+    steps = max(scan, steps - steps % scan)   # whole number of scans
+    warmup = max(warmup, scan)
 
     comm = chainermn_tpu.create_communicator(
         "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
     log(f"bench: backend={jax.default_backend()} devices={n_dev} "
-        f"batch/chip={per_chip_batch} image={image}")
+        f"batch/chip={per_chip_batch} image={image} stem={stem} "
+        f"scan={scan}")
 
     variables = model.init(
         jax.random.key(0), jnp.zeros((1, image, image, 3), jnp.float32))
@@ -111,7 +123,8 @@ def run(args) -> dict:
             logits, y).mean()
         return loss, mutated["batch_stats"]
 
-    step = make_train_step(comm, loss_fn, optimizer, with_model_state=True)
+    step = make_train_step(comm, loss_fn, optimizer, with_model_state=True,
+                           scan_steps=scan)
 
     global_batch = per_chip_batch * comm.size
     rng = np.random.RandomState(0)
@@ -119,7 +132,7 @@ def run(args) -> dict:
     y = (rng.rand(global_batch) * n_classes).astype(np.int32)
     batch = put_global_batch(comm, (x, y))
 
-    for i in range(warmup):
+    for i in range(warmup // scan):
         params, model_state, opt_state, loss = step(
             params, model_state, opt_state, batch)
     jax.block_until_ready(loss)
@@ -128,7 +141,7 @@ def run(args) -> dict:
     if args.profile:
         jax.profiler.start_trace(args.profile)
     t0 = time.perf_counter()
-    for i in range(steps):
+    for i in range(steps // scan):
         params, model_state, opt_state, loss = step(
             params, model_state, opt_state, batch)
     # Value read, not just block_until_ready: on the tunneled TPU platform
@@ -152,6 +165,8 @@ def run(args) -> dict:
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
     }
+    out["stem"] = stem
+    out["scan_steps"] = scan
     if on_tpu:
         peak = _peak_tflops(jax.devices()[0])
         mfu = per_chip * TRAIN_GFLOP_PER_IMAGE / 1e3 / peak
@@ -164,22 +179,6 @@ def run(args) -> dict:
     return out
 
 
-def _is_transient(exc: BaseException) -> bool:
-    msg = f"{type(exc).__name__}: {exc}".lower()
-    # Deterministic failure categories: retrying re-runs the full
-    # init+warmup+measure cycle for minutes only to hit the same wall.
-    if "resource_exhausted" in msg or "invalid_argument" in msg \
-            or "out of memory" in msg or "unimplemented" in msg \
-            or "not implemented" in msg:
-        return False
-    if any(s in msg for s in TRANSIENT_MARKERS):
-        return True
-    # Any other XLA/jax runtime error on the tunneled backend is far more
-    # likely a transport hiccup than a benchmark bug (the code path is
-    # test-covered on CPU); err on the side of retrying those too.
-    return "xlaruntimeerror" in msg or "jaxruntimeerror" in msg
-
-
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--profile", default=None, metavar="DIR",
@@ -187,34 +186,18 @@ def main():
                              "steps into DIR")
     parser.add_argument("--attempts", type=int, default=3,
                         help="max benchmark attempts before giving up")
+    parser.add_argument("--stem", choices=["conv7", "s2d"], default=None,
+                        help="ResNet stem: conv7 (reference 7x7/s2, "
+                             "default) or s2d (space-to-depth, the TPU "
+                             "MLPerf transform; measured equal here)")
+    parser.add_argument("--scan", type=int, default=None,
+                        help="train steps fused per dispatch via lax.scan "
+                             "(default 1; >1 measured SLOWER on this model "
+                             "- scan-body layout assignment)")
     args = parser.parse_args()
 
-    out = None
-    for attempt in range(1, max(1, args.attempts) + 1):
-        try:
-            out = run(args)
-            break
-        except Exception as e:  # noqa: BLE001 — classified below
-            transient = _is_transient(e)
-            log(f"bench: attempt {attempt}/{args.attempts} failed with "
-                f"{type(e).__name__}: {e} (transient={transient})")
-            if attempt >= args.attempts or not transient:
-                raise
-            # Best-effort fresh start: close a profiler trace the failed
-            # attempt may have left open (start_trace would raise on the
-            # retry) and drop compiled executables so the next attempt
-            # re-issues remote_compile on a fresh request.
-            try:
-                import jax
-                if args.profile:
-                    try:
-                        jax.profiler.stop_trace()
-                    except Exception:
-                        pass
-                jax.clear_caches()
-            except Exception as ce:
-                log(f"bench: backend cleanup failed ({ce}); continuing")
-            time.sleep(5 * attempt)
+    out = retry_transient(lambda: run(args), attempts=args.attempts,
+                          label="bench")
     print(json.dumps(out), flush=True)
 
 

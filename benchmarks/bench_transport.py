@@ -25,6 +25,9 @@ import sys
 
 DEFAULT_SIZES = [1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 22,
                  1 << 24, 1 << 26]  # 1 KB .. 64 MB
+# --big extends to the GiB regime (VERDICT r3 #4): 256 MB, 1 GiB, 2 GiB —
+# the scatter_dataset-scale objects the reference's INT_MAX chunking served.
+BIG_SIZES = [1 << 28, 1 << 30, 1 << 31]
 QUICK_SIZES = [1 << 10, 1 << 16, 1 << 20]
 
 _WORKER_TEMPLATE = r"""
@@ -44,7 +47,7 @@ backend = type(t).__name__
 TAG = 7
 results = {}
 for sz in sizes:
-    reps = max(3, min(reps_cap, (1 << 24) // sz))
+    reps = 2 if sz >= (1 << 28) else max(3, min(reps_cap, (1 << 24) // sz))
     payload = b"\x5a" * sz
     if rank == 0:
         t.send(1, TAG, payload)          # warm the connection + allocator
@@ -86,8 +89,13 @@ def main():
     ap.add_argument("--out", default=None, help="also write JSON here")
     ap.add_argument("--quick", action="store_true",
                     help="3 sizes, few reps (smoke)")
+    ap.add_argument("--big", action="store_true",
+                    help="extend the sweep to 256 MB / 1 GiB / 2 GiB "
+                         "payloads (minutes; GiB-scale goodput evidence)")
     args = ap.parse_args()
     sizes = QUICK_SIZES if args.quick else DEFAULT_SIZES
+    if args.big:
+        sizes = sizes + BIG_SIZES
     reps_cap = 5 if args.quick else 50
 
     runs = {}

@@ -29,6 +29,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -79,6 +80,47 @@ bool recv_all(int fd, void* buf, size_t n) {
   return true;
 }
 
+// Frame payload holder.  ::malloc-backed (NOT std::string: resize()
+// value-initializes, which at GiB payloads is a full extra memory pass —
+// measured to collapse loopback goodput to 58 MB/s at 2 GiB on this
+// 1-core host).  Ownership moves through the inbox and is RELEASED to the
+// ctypes caller in dcn_recv (who frees via dcn_free -> ::free), so the
+// receive path's only copies are the socket read and the final
+// Python-bytes construction — same count as the Python fallback.
+struct Buffer {
+  uint8_t* data = nullptr;
+  uint64_t len = 0;
+  Buffer() = default;
+  explicit Buffer(uint64_t n)
+      : data(static_cast<uint8_t*>(::malloc(n ? n : 1))), len(n) {}
+  Buffer(const void* src, uint64_t n) : Buffer(n) {
+    if (data && n) std::memcpy(data, src, n);
+  }
+  Buffer(Buffer&& o) noexcept : data(o.data), len(o.len) {
+    o.data = nullptr;
+    o.len = 0;
+  }
+  Buffer& operator=(Buffer&& o) noexcept {
+    if (this != &o) {
+      ::free(data);
+      data = o.data;
+      len = o.len;
+      o.data = nullptr;
+      o.len = 0;
+    }
+    return *this;
+  }
+  Buffer(const Buffer&) = delete;
+  Buffer& operator=(const Buffer&) = delete;
+  ~Buffer() { ::free(data); }
+  uint8_t* release() {
+    uint8_t* p = data;
+    data = nullptr;
+    len = 0;
+    return p;
+  }
+};
+
 bool write_frame(int fd, uint32_t src, uint32_t tag, const void* payload,
                  uint64_t len) {
   FrameHeader h{src, tag, len};
@@ -93,11 +135,13 @@ bool write_frame(int fd, uint32_t src, uint32_t tag, const void* payload,
   return send_all(fd, &h, sizeof(h)) && send_all(fd, payload, len);
 }
 
-bool read_frame(int fd, uint32_t* src, uint32_t* tag, std::string* payload) {
+bool read_frame(int fd, uint32_t* src, uint32_t* tag, Buffer* payload) {
   FrameHeader h;
   if (!recv_all(fd, &h, sizeof(h))) return false;
-  payload->resize(h.len);
-  if (h.len && !recv_all(fd, payload->data(), h.len)) return false;
+  Buffer buf(h.len);
+  if (!buf.data) return false;  // allocation failed (absurd len / OOM)
+  if (h.len && !recv_all(fd, buf.data, h.len)) return false;
+  *payload = std::move(buf);
   *src = h.src;
   *tag = h.tag;
   return true;
@@ -187,7 +231,19 @@ std::string dump_table(const std::map<int, std::string>& table) {
 
 class Transport {
  public:
-  Transport(int rank, int size) : rank_(rank), size_(size) {}
+  Transport(int rank, int size) : rank_(rank), size_(size) {
+    // Inbox byte budget (backpressure): a reader thread blocks before
+    // pushing the inbox past this mark, so unread bytes stay in the kernel
+    // socket buffers and TCP flow control stalls the peer.  Memory is then
+    // bounded at ~HWM + one message regardless of how far ahead a sender
+    // runs (the GiB-scale analogue of the reference's INT_MAX chunking
+    // concern).  Mirrors the Python fallback's budget.
+    if (const char* env = std::getenv("CHAINERMN_TPU_INBOX_HWM")) {
+      char* end = nullptr;
+      unsigned long long v = std::strtoull(env, &end, 10);
+      if (end && *end == '\0' && v > 0) hwm_ = v;
+    }
+  }
 
   bool init(const std::string& coordinator, const std::string& my_host) {
     // Listen on an ephemeral port.
@@ -218,8 +274,9 @@ class Transport {
 
   bool send(int dest, uint32_t tag, const void* data, uint64_t len) {
     if (dest == rank_) {
-      std::string payload(static_cast<const char*>(data), len);
-      push(rank_, tag, std::move(payload));
+      Buffer copy(data, len);
+      if (!copy.data) return fail("self-send allocation failed");
+      push(rank_, tag, std::move(copy), /*wait_budget=*/false);
       return true;
     }
     // Register as an in-flight sender for the WHOLE call — including the
@@ -276,7 +333,7 @@ class Transport {
   }
 
   // Returns true and fills *out, or false on timeout/shutdown.
-  bool recv(int source, uint32_t tag, double timeout_s, std::string* out) {
+  bool recv(int source, uint32_t tag, double timeout_s, Buffer* out) {
     std::unique_lock<std::mutex> lk(inbox_mutex_);
     // Registered so close() can wait for in-flight receivers to drain
     // before the object is destroyed (use-after-free otherwise).
@@ -289,6 +346,7 @@ class Transport {
     if (success) {
       *out = std::move(inbox_[key].front());
       inbox_[key].pop_front();
+      inbox_bytes_ -= out->len;  // releases parked readers via notify
     }
     --active_recvs_;
     inbox_cv_.notify_all();
@@ -379,9 +437,21 @@ class Transport {
     return false;
   }
 
-  void push(int src, uint32_t tag, std::string&& payload) {
+  // wait_budget: reader threads park while the inbox is over budget
+  // (backpressure via TCP); self-sends never wait (the sender would be
+  // waiting on itself).  One message is always admitted once under the
+  // mark, so payloads larger than the budget still pass.
+  void push(int src, uint32_t tag, Buffer&& payload, bool wait_budget) {
     {
-      std::lock_guard<std::mutex> g(inbox_mutex_);
+      std::unique_lock<std::mutex> lk(inbox_mutex_);
+      if (wait_budget) {
+        inbox_cv_.wait(lk, [&] {
+          return closed_.load() || inbox_bytes_ < hwm_;
+        });
+        if (closed_.load()) return;  // teardown: connection is dying anyway
+      }
+      inbox_bytes_ += payload.len;
+      peak_inbox_bytes_ = std::max(peak_inbox_bytes_, inbox_bytes_);
       inbox_[{src, tag}].push_back(std::move(payload));
     }
     inbox_cv_.notify_all();
@@ -416,10 +486,10 @@ class Transport {
 
   void reader_loop(int fd) {
     uint32_t src, tag;
-    std::string payload;
+    Buffer payload;
     while (read_frame(fd, &src, &tag, &payload)) {
-      push(static_cast<int>(src), tag, std::move(payload));
-      payload.clear();
+      push(static_cast<int>(src), tag, std::move(payload),
+           /*wait_budget=*/true);
     }
     {
       // De-register before closing: otherwise close() could ::shutdown a
@@ -454,12 +524,13 @@ class Transport {
           return fail("coordinator accept failed");
         }
         uint32_t src, tag;
-        std::string payload;
+        Buffer payload;
         if (!read_frame(c, &src, &tag, &payload)) {
           ::close(c);
           continue;
         }
-        peers_[static_cast<int>(src)] = payload;
+        peers_[static_cast<int>(src)] = std::string(
+            reinterpret_cast<const char*>(payload.data), payload.len);
         conns.emplace_back(static_cast<int>(src), c);
       }
       std::string blob = dump_table(peers_);
@@ -477,10 +548,11 @@ class Transport {
       return fail("handshake send failed");
     }
     uint32_t src, tag;
-    std::string blob;
-    bool ok = read_frame(c, &src, &tag, &blob);
+    Buffer raw;
+    bool ok = read_frame(c, &src, &tag, &raw);
     ::close(c);
     if (!ok) return fail("handshake recv failed");
+    std::string blob(reinterpret_cast<const char*>(raw.data), raw.len);
     if (!parse_table(blob, &peers_)) return fail("bad handshake table: " + blob);
     return true;
   }
@@ -504,7 +576,18 @@ class Transport {
 
   std::mutex inbox_mutex_;
   std::condition_variable inbox_cv_;
-  std::map<std::pair<int, uint32_t>, std::deque<std::string>> inbox_;
+  std::map<std::pair<int, uint32_t>, std::deque<Buffer>> inbox_;
+
+ public:
+  uint64_t hwm_ = 1ull << 30;          // see ctor
+  uint64_t inbox_bytes_ = 0;           // guarded by inbox_mutex_
+  uint64_t peak_inbox_bytes_ = 0;      // guarded by inbox_mutex_
+
+  void stats(uint64_t* inbox_bytes, uint64_t* peak) {
+    std::lock_guard<std::mutex> g(inbox_mutex_);
+    *inbox_bytes = inbox_bytes_;
+    *peak = peak_inbox_bytes_;
+  }
 };
 
 }  // namespace
@@ -547,16 +630,16 @@ int dcn_send(void* handle, int dest, uint32_t tag, const uint8_t* data,
 }
 
 // On success returns len and sets *out (caller frees with dcn_free); on
-// failure returns -1.
+// failure returns -1.  Zero-copy: the Buffer read off the wire is released
+// to the caller directly (::malloc-backed, freed by dcn_free's ::free).
 int64_t dcn_recv(void* handle, int source, uint32_t tag, double timeout_s,
                  uint8_t** out) try {
-  std::string payload;
+  Buffer payload;
   if (!static_cast<Transport*>(handle)->recv(source, tag, timeout_s, &payload))
     return -1;
-  auto* buf = static_cast<uint8_t*>(::malloc(payload.size()));
-  std::memcpy(buf, payload.data(), payload.size());
-  *out = buf;
-  return static_cast<int64_t>(payload.size());
+  int64_t n = static_cast<int64_t>(payload.len);
+  *out = payload.release();
+  return n;
 } catch (const std::exception& e) {
   set_error(std::string("native recv: ") + e.what());
   return -1;
@@ -598,6 +681,13 @@ void dcn_destroy(void* handle) { delete static_cast<Transport*>(handle); }
 void dcn_close(void* handle) {
   dcn_shutdown(handle);
   dcn_destroy(handle);
+}
+
+// Inbox buffering stats: current bytes queued and the high-water peak.
+// Lets callers (tests, benchmarks) assert the backpressure bound
+// peak <= HWM + largest message without instrumenting the process.
+void dcn_stats(void* handle, uint64_t* inbox_bytes, uint64_t* peak) {
+  static_cast<Transport*>(handle)->stats(inbox_bytes, peak);
 }
 
 const char* dcn_last_error() { return g_last_error.c_str(); }

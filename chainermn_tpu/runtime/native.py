@@ -64,6 +64,9 @@ def _load() -> ctypes.CDLL:
             lib.dcn_peers.restype = ctypes.c_int64
             lib.dcn_peers.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_int64]
+            lib.dcn_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.POINTER(ctypes.c_uint64)]
             lib.dcn_close.argtypes = [ctypes.c_void_p]
             lib.dcn_shutdown.argtypes = [ctypes.c_void_p]
             lib.dcn_destroy.argtypes = [ctypes.c_void_p]
@@ -128,6 +131,19 @@ class NativeTransport:
         finally:
             self._exit()
 
+    @property
+    def peak_inbox_bytes(self) -> int:
+        """High-water mark of inbox buffering (backpressure evidence)."""
+        self._enter()
+        try:
+            cur = ctypes.c_uint64()
+            peak = ctypes.c_uint64()
+            self._lib.dcn_stats(self._handle, ctypes.byref(cur),
+                                ctypes.byref(peak))
+            return int(peak.value)
+        finally:
+            self._exit()
+
     def send(self, dest: int, tag: int, payload: bytes):
         self._enter()
         try:
@@ -150,7 +166,14 @@ class NativeTransport:
                     f"native recv from rank {source} (tag {tag}): "
                     f"{self._lib.dcn_last_error().decode()}")
             try:
-                return ctypes.string_at(out, n)
+                if n < (1 << 31):
+                    return ctypes.string_at(out, n)
+                # ctypes._string_at takes a C int internally; >=2 GiB sizes
+                # wrap negative.  Cast to a fixed-size array instead (array
+                # lengths are ssize_t) and copy out.
+                return bytes(
+                    ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8 * n))
+                    .contents)
             finally:
                 self._lib.dcn_free(out)
         finally:

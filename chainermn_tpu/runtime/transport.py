@@ -27,14 +27,32 @@ from typing import Dict, Tuple
 
 _HDR = struct.Struct("<IIQ")
 
+# Inbox high-water mark (bytes).  When a reader thread would push the inbox
+# past this, it blocks until a consumer drains — TCP flow control then
+# backpressures the sender, so memory stays bounded at roughly
+# HWM + one message no matter how far ahead a peer runs.  The reference hit
+# the same scale problem as an INT_MAX chunking workaround
+# 〔mpi_communicator_base.py, SURVEY §2.1〕; here the u64 framing removes the
+# wire limit and this budget bounds the buffering.
+_DEFAULT_HWM = 1 << 30
+
+
+def _inbox_hwm() -> int:
+    return int(os.environ.get("CHAINERMN_TPU_INBOX_HWM", _DEFAULT_HWM))
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: GiB-scale frames must not allocate a
+    # fresh buffer per recv() call (socket.recv allocates its bufsize
+    # argument up front) and must not round-trip through bytearray.extend.
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if not k:
             raise ConnectionError("peer closed connection")
-        buf.extend(chunk)
+        got += k
     return bytes(buf)
 
 
@@ -46,6 +64,11 @@ class PyTransport:
         self.size = size
         self._inbox: Dict[Tuple[int, int], queue.Queue] = {}
         self._inbox_lock = threading.Lock()
+        # Inbox byte budget (backpressure) — see _DEFAULT_HWM above.
+        self._hwm = _inbox_hwm()
+        self._inbox_bytes = 0
+        self.peak_inbox_bytes = 0
+        self._budget_cv = threading.Condition(self._inbox_lock)
         self._out: Dict[int, socket.socket] = {}
         # Per-destination locks: one slow peer must not serialize the whole
         # outbound plane (bcast from rank 0 fans out concurrently).
@@ -107,7 +130,17 @@ class PyTransport:
     # -- framing -------------------------------------------------------------
     @staticmethod
     def _write_frame(sock, src, tag, payload: bytes):
-        sock.sendall(_HDR.pack(src, tag, len(payload)) + payload)
+        if len(payload) <= 64 * 1024:
+            # One write for small frames (avoids a partial-header interleave
+            # risk under TCP_NODELAY and halves syscalls on the hot
+            # control-plane path).
+            sock.sendall(_HDR.pack(src, tag, len(payload)) + payload)
+        else:
+            # Large frames: header then the payload itself — concatenating
+            # would copy the whole (possibly multi-GiB) buffer.  sendall
+            # streams from the original object; the kernel chunks it.
+            sock.sendall(_HDR.pack(src, tag, len(payload)))
+            sock.sendall(payload)
 
     @staticmethod
     def _read_frame(sock):
@@ -127,7 +160,7 @@ class PyTransport:
         try:
             while True:
                 src, tag, payload = self._read_frame(conn)
-                self._q(src, tag).put(payload)
+                self._enqueue(src, tag, payload, wait_budget=True)
         except (ConnectionError, OSError):
             conn.close()
 
@@ -135,10 +168,30 @@ class PyTransport:
         with self._inbox_lock:
             return self._inbox.setdefault((src, tag), queue.Queue())
 
+    def _enqueue(self, src, tag, payload, wait_budget: bool):
+        with self._budget_cv:
+            if wait_budget:
+                # Reader threads block while the inbox is over budget; the
+                # unread bytes then sit in the kernel socket buffers and TCP
+                # flow control stalls the sender.  One message is always
+                # admitted once the inbox is under the mark, so a single
+                # payload larger than the budget still passes (peak usage
+                # <= HWM + largest message).  Self-sends (wait_budget=False)
+                # never block: the sender would be waiting on itself.
+                while self._inbox_bytes >= self._hwm and not self._closed:
+                    self._budget_cv.wait()
+                if self._closed:
+                    return
+            self._inbox_bytes += len(payload)
+            self.peak_inbox_bytes = max(self.peak_inbox_bytes,
+                                        self._inbox_bytes)
+            q = self._inbox.setdefault((src, tag), queue.Queue())
+        q.put(payload)
+
     # -- public API ----------------------------------------------------------
     def send(self, dest: int, tag: int, payload: bytes):
         if dest == self.rank:
-            self._q(self.rank, tag).put(payload)
+            self._enqueue(self.rank, tag, payload, wait_budget=False)
             return
         with self._out_locks_guard:
             lock = self._out_locks.setdefault(dest, threading.Lock())
@@ -153,14 +206,20 @@ class PyTransport:
 
     def recv(self, source: int, tag: int, timeout: float = 300.0) -> bytes:
         try:
-            return self._q(source, tag).get(timeout=timeout)
+            payload = self._q(source, tag).get(timeout=timeout)
         except queue.Empty:
             raise TimeoutError(
                 f"recv from rank {source} (tag {tag}) timed out after {timeout}s"
             ) from None
+        with self._budget_cv:
+            self._inbox_bytes -= len(payload)
+            self._budget_cv.notify_all()
+        return payload
 
     def close(self):
         self._closed = True
+        with self._budget_cv:
+            self._budget_cv.notify_all()  # wake readers parked on the budget
         try:
             self._listener.close()
         except OSError:

