@@ -81,7 +81,7 @@ def run(args) -> dict:
     # the scan body).  Defaults therefore stay at the reference semantics;
     # both knobs remain available for measurement.
     stem = args.stem or "conv7"
-    scan = args.scan if args.scan else 1
+    scan = 1 if args.scan is None else args.scan
     if scan < 1:
         raise SystemExit(f"--scan must be >= 1, got {scan}")
     if on_tpu:

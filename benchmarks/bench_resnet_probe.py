@@ -13,9 +13,22 @@ measured subtraction, not a guess from trace categories:
   nonorm      — BatchNorm replaced by identity (all BN work vanishes)
   fwdonly     — forward pass only (no grad)
   fwdbwd      — fwd+bwd only (no allreduce/update)
+  s2d         — full step with the space-to-depth stem (round-4
+                countermeasure #1; measured a wash — see performance.md)
+  remat       — full step with every residual block rematerialized
+                (nn.remat): prices whether trading HBM activation traffic
+                for recompute moves the memory-bound stages
 
 Run on the real chip:  python benchmarks/bench_resnet_probe.py
 Each variant reports ms/step and img/s; deltas vs `full` are printed.
+
+``--stages`` switches to per-stage isolation mode: each ResNet-50 stage's
+blocks run fwd+bwd alone on a synthetic activation (device-time ms +
+TFLOP/s), plus a ``stage1_pad128`` row — the stage-1 shape widened from
+64 to 128 channels, the MXU-lane-occupancy countermeasure (round-4 #2):
+if 128-channel TFLOP/s ~= 2x the 64-channel rate, stage 1 is lane-bound
+and padding could pay; if it only matches, the stage is at its memory
+roofline and the 64-lane half-occupancy is not the binding constraint.
 
 NOTE: nostats/nonorm change the numerics (loss is garbage) — they exist
 only to price the memory traffic; they are never used for training.
@@ -50,11 +63,106 @@ def time_step(step, args, steps, warmup):
     return (time.perf_counter() - t0) / steps
 
 
+def run_stage_isolation(args):
+    """Per-stage fwd+bwd device time + TFLOP/s, and the pad128 lane probe.
+
+    Each ResNet-50 stage's block sequence runs alone on a synthetic
+    bf16 activation of the right shape (b=args.batch), timed by device
+    timestamps.  `stage1_pad128` widens stage-1's bottleneck width from
+    64 to 128 on the same 56x56 spatial grid: if its TFLOP/s is ~2x
+    stage1's, the 64-channel shapes are MXU-lane-bound; if similar, the
+    stage is memory-roofline-bound and lane padding cannot pay.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.resnet import BottleneckBlock
+    from chainermn_tpu.utils.trace import device_time
+
+    b = args.batch
+
+    class StageStack(nn.Module):
+        filters: int
+        count: int
+        first_stride: int
+
+        @nn.compact
+        def __call__(self, x):
+            from functools import partial
+            conv = partial(nn.Conv, use_bias=False, dtype=jnp.bfloat16,
+                           param_dtype=jnp.float32, padding="SAME")
+            norm = partial(nn.BatchNorm, use_running_average=False,
+                           momentum=0.9, epsilon=1e-5, dtype=jnp.bfloat16,
+                           param_dtype=jnp.float32)
+            for j in range(self.count):
+                strides = ((self.first_stride,) * 2 if j == 0 else (1, 1))
+                x = BottleneckBlock(self.filters, conv=conv, norm=norm,
+                                    strides=strides)(x)
+            return x
+
+    def stage_flops_fwd(h_in, c_in, f, count, stride):
+        """Forward conv FLOPs of a bottleneck stack (BN/relu excluded)."""
+        total = 0
+        c = c_in
+        h = h_in
+        for j in range(count):
+            s = stride if j == 0 else 1
+            h_out = h // s
+            n_out = b * h_out * h_out
+            n_in = b * h * h
+            total += 2 * (n_in * c * f            # 1x1 reduce
+                          + n_out * f * f * 9     # 3x3 (stride s)
+                          + n_out * f * 4 * f)    # 1x1 expand
+            if c != 4 * f or s != 1:
+                total += 2 * n_out * c * 4 * f    # projection shortcut
+            c, h = 4 * f, h_out
+        return total
+
+    # (name, spatial_in, c_in, filters, blocks, first_stride)
+    rows = [
+        ("stage1", 56, 64, 64, 3, 1),
+        ("stage1_pad128", 56, 128, 128, 3, 1),
+        ("stage2", 56, 256, 128, 4, 2),
+        ("stage3", 28, 512, 256, 6, 2),
+        ("stage4", 14, 1024, 512, 3, 2),
+    ]
+    rng = np.random.RandomState(0)
+    for name, hw, c_in, f, count, stride in rows:
+        model = StageStack(filters=f, count=count, first_stride=stride)
+        x = jnp.asarray(rng.randn(b, hw, hw, c_in), jnp.bfloat16)
+        variables = model.init(jax.random.key(0), x)
+
+        def loss(p, xx, model=model):
+            y, _ = model.apply({"params": p, "batch_stats":
+                                variables["batch_stats"]}, xx,
+                               mutable=["batch_stats"])
+            return jnp.sum(y.astype(jnp.float32))
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        ms = device_time(lambda: g(variables["params"], x), (), steps=5,
+                         warmup=2)
+        if ms <= 0:  # no TPU device track (CPU run): fall back to wall
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = g(variables["params"], x)
+            jax.block_until_ready(out)
+            float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+            ms = (time.perf_counter() - t0) / 3 * 1e3
+        flops = 3 * stage_flops_fwd(hw, c_in, f, count, stride)  # fwd+bwd
+        tflops = flops / (ms / 1e3) / 1e12
+        log(f"{name:14s}  {ms:7.2f} ms  {tflops:6.1f} TFLOP/s "
+            f"(fwd+bwd, {count} blocks @ {hw}x{hw}, width {f})")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--variants", default="full,nostats,nonorm,fwdonly,fwdbwd")
+    p.add_argument("--stages", action="store_true",
+                   help="per-stage isolation + pad128 lane probe instead "
+                        "of step variants")
     args = p.parse_args()
 
     import flax.linen as nn
@@ -106,6 +214,9 @@ def main():
         def __call__(self, x):
             return x
 
+    if args.stages:
+        return run_stage_isolation(args)
+
     n_classes = 1000
     image = 224
     comm = chainermn_tpu.create_communicator(
@@ -116,14 +227,28 @@ def main():
     y = (rng.rand(args.batch) * n_classes).astype(np.int32)
     batch = put_global_batch(comm, (x, y))
 
+    known_variants = {"full", "nostats", "nonorm", "fwdonly", "fwdbwd",
+                      "s2d", "remat"}
+    wanted = args.variants.split(",")
+    unknown = set(wanted) - known_variants
+    if unknown:
+        # A typo must not silently re-measure the full model under the
+        # wrong label (a zero delta would read as "countermeasure inert").
+        raise SystemExit(f"unknown variant(s) {sorted(unknown)}; "
+                         f"available: {sorted(known_variants)}")
     results = {}
-    for variant in args.variants.split(","):
+    for variant in wanted:
         norm_cls = {"nostats": ConstStatBN, "nonorm": IdentityNorm}.get(
             variant)
-        model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16)
+        kw = dict(num_classes=n_classes, dtype=jnp.bfloat16)
         if norm_cls is not None:
-            model = ResNet50(num_classes=n_classes, dtype=jnp.bfloat16,
-                             norm_cls=norm_cls)
+            kw["norm_cls"] = norm_cls
+        if variant == "s2d":
+            kw["stem"] = "s2d"
+        if variant == "remat":
+            from chainermn_tpu.models.resnet import BottleneckBlock
+            kw["block_cls"] = nn.remat(BottleneckBlock)
+        model = ResNet50(**kw)
         variables = model.init(
             jax.random.key(0), jnp.zeros((1, image, image, 3), jnp.float32))
         params = variables["params"]

@@ -80,6 +80,18 @@ def _timed(step_fn, state, steps, warmup):
     return state, time.perf_counter() - t0
 
 
+# Timing discipline knobs for accelerator rows (set from --repeats in
+# main): N>=5 timed windows -> median + spread, plus a device-time capture.
+# CPU smoke rows always run a single window (their numbers are not
+# evidence; the "smoke" marker says so).
+_TPU_REPEATS = 5
+
+
+def _tpu_timing_kw(on_tpu):
+    return (dict(repeats=_TPU_REPEATS, device_ms=True) if on_tpu
+            else dict())
+
+
 def _need_devices(n):
     """Ensure >= n devices, resetting to the virtual CPU mesh if needed."""
     from chainermn_tpu.utils.cpu_mesh import ensure_device_count
@@ -88,8 +100,20 @@ def _need_devices(n):
 
 
 def _dp_image_bench(model, comm, *, image, n_classes, per_chip_batch,
-                    steps, warmup, double_buffering, rngs=None):
-    """Shared data-parallel image-training harness (configs 1, 2, 3, 5)."""
+                    steps, warmup, double_buffering, rngs=None,
+                    repeats=1, device_ms=False):
+    """Shared data-parallel image-training harness (configs 1, 2, 3, 5).
+
+    ``repeats``: how many timed windows to measure (median reported, with
+    min/max spread) — the round-3 ``vgg16_cifar_db`` number swung ±15%
+    across rounds because each round was a single window through the
+    device tunnel; N>=5 windows + the median is the repo's own timing
+    discipline (VERDICT r3 weak #2).  ``device_ms``: additionally measure
+    per-step on-DEVICE time from a profiler capture
+    (``utils.trace.device_time``) — stable against tunnel jitter by
+    construction, so comparing it with the wall median attributes any
+    remaining spread to host/tunnel vs the chip.
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -148,14 +172,35 @@ def _dp_image_bench(model, comm, *, image, n_classes, per_chip_batch,
             return step(p, os_, batch)
         state = (params, opt_state, jnp.zeros(()))
 
-    state, dt = _timed(one, state, steps, warmup)
+    dts = []
+    for rep in range(max(1, repeats)):
+        state, dt = _timed(one, state, steps, warmup if rep == 0 else 0)
+        dts.append(dt)
+    dt_med = float(np.median(dts))
     loss = float(state[-1])
-    return {
-        "images_per_sec": global_batch * steps / dt,
-        "images_per_sec_per_chip": global_batch * steps / dt / comm.size,
+    out = {
+        "images_per_sec": global_batch * steps / dt_med,
+        "images_per_sec_per_chip": global_batch * steps / dt_med / comm.size,
         "devices": comm.size,
         "final_loss": round(loss, 4),
     }
+    if repeats > 1:
+        out["repeats"] = len(dts)
+        out["wall_ms_per_step_median"] = round(dt_med / steps * 1e3, 2)
+        out["wall_spread_pct"] = round(
+            100 * (max(dts) - min(dts)) / dt_med, 1)
+    if device_ms:
+        from chainermn_tpu.utils.trace import device_time
+
+        box = [state]
+
+        def fn():
+            box[0] = one(box[0])
+            return box[0]
+
+        out["device_ms_per_step"] = round(
+            device_time(fn, (), steps=5, warmup=1), 2)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -235,7 +280,8 @@ def bench_resnet50_xla():
                   steps=5, warmup=2)
     comm = chainermn_tpu.create_communicator(
         "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
-    r = _dp_image_bench(model, comm, double_buffering=True, **kw)
+    r = _dp_image_bench(model, comm, double_buffering=True,
+                        **_tpu_timing_kw(on_tpu), **kw)
     return {
         "config": "resnet50_xla",
         "metric": "resnet50_xla_train_throughput" if on_tpu
@@ -245,6 +291,9 @@ def bench_resnet50_xla():
         "devices": r["devices"],
         "communicator": "xla(bf16)" if on_tpu else "xla",
         "final_loss": r["final_loss"],
+        **{k: r[k] for k in ("repeats", "wall_ms_per_step_median",
+                             "wall_spread_pct", "device_ms_per_step")
+           if k in r},
     }
 
 
@@ -270,7 +319,8 @@ def bench_vgg16_cifar_db():
     comm = chainermn_tpu.create_communicator(
         "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
     rngs = {"dropout": jax.random.key(1)}
-    r = _dp_image_bench(model, comm, double_buffering=True, rngs=rngs, **kw)
+    r = _dp_image_bench(model, comm, double_buffering=True, rngs=rngs,
+                        **_tpu_timing_kw(on_tpu), **kw)
     return {
         "config": "vgg16_cifar_db",
         "metric": "vgg16_cifar10_double_buffered_train_throughput"
@@ -281,6 +331,9 @@ def bench_vgg16_cifar_db():
         "communicator": "xla(bf16)+double_buffering" if on_tpu
                         else "xla+double_buffering",
         "final_loss": r["final_loss"],
+        **{k: r[k] for k in ("repeats", "wall_ms_per_step_median",
+                             "wall_spread_pct", "device_ms_per_step")
+           if k in r},
     }
 
 
@@ -364,7 +417,8 @@ def bench_resnet50_hier():
         kw = dict(image=32, n_classes=10, per_chip_batch=8,
                   steps=5, warmup=2)
     comm = chainermn_tpu.create_communicator("hierarchical", intra_size=n // 2)
-    r = _dp_image_bench(model, comm, double_buffering=True, **kw)
+    r = _dp_image_bench(model, comm, double_buffering=True,
+                        **_tpu_timing_kw(on_tpu and n >= 4), **kw)
     return {
         "config": "resnet50_hier",
         "metric": "resnet50_hierarchical_multichip_train_throughput"
@@ -374,6 +428,9 @@ def bench_resnet50_hier():
         "devices": r["devices"],
         "communicator": f"hierarchical (inter=2 x intra={n // 2})",
         "final_loss": r["final_loss"],
+        **{k: r[k] for k in ("repeats", "wall_ms_per_step_median",
+                             "wall_spread_pct", "device_ms_per_step")
+           if k in r},
     }
 
 
@@ -394,7 +451,14 @@ def main():
                         help="comma-separated subset (default: all five)")
     parser.add_argument("--out", default=None,
                         help="also write results to this JSON file")
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="timed windows per accelerator row (median "
+                             "reported with min/max spread; default 5)")
     args = parser.parse_args()
+    global _TPU_REPEATS
+    if args.repeats < 1:
+        parser.error("--repeats must be >= 1")
+    _TPU_REPEATS = args.repeats
     wanted = args.configs.split(",") if args.configs else [
         name for name, _ in _CONFIGS]
     unknown = set(wanted) - {name for name, _ in _CONFIGS}

@@ -22,5 +22,21 @@ class SingleNodeCommunicator(MeshCommunicator):
     def _allreduce_grad_traced(self, grads):
         import jax
         intra_axis = self._data_axes[-1]
+        inter_axes = self._data_axes[:-1]
         n = self.size
-        return jax.tree.map(lambda g: lax.psum(g, intra_axis) / n, grads)
+
+        def one(g):
+            g = lax.psum(g, intra_axis)   # the ICI leg — the whole reduction
+            if inter_axes:
+                # inter_size == 1 is a class invariant (checked in
+                # __init__), so this psum moves no data.  It exists to
+                # clear the device-varying type over the trivial inter
+                # axes: pvary marks gradients varying over ALL data axes,
+                # and shard_map's replication check rejects the invariant
+                # params out_spec if any axis's variance survives —
+                # exactly what happened on a 1-device world (found by
+                # tools/tpu_smoke.py on the real chip).
+                g = lax.psum(g, inter_axes)
+            return g / n
+
+        return jax.tree.map(one, grads)

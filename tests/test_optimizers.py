@@ -61,14 +61,18 @@ class TestMultiNodeOptimizer:
 
 @pytest.mark.parametrize("flavor", [
     "naive", "flat", "hierarchical", "two_dimensional", "non_cuda_aware",
-    "xla"])
+    "xla", "single_node"])
 def test_train_step_compiles_for_every_flavor(flavor):
     """Regression: the FULL train step (replicated params out_spec) must
     compile and produce the mean-gradient update for every communicator
     decomposition.  two_dimensional's all_gather leg once produced
     vma-varying gradients that poisoned the replicated out_spec — caught
-    only when the whole step was jitted, not by collective-level tests."""
-    comm = chainermn_tpu.create_communicator(flavor, intra_size=4)
+    only when the whole step was jitted, not by collective-level tests.
+    single_node (inter_size must be 1 -> intra_size=8) once left the
+    trivial inter axis's variance uncleared, failing the same check on
+    1-device worlds."""
+    comm = chainermn_tpu.create_communicator(
+        flavor, intra_size=8 if flavor == "single_node" else 4)
     opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(1.0), comm)
     params = {"w": jnp.zeros((3,))}
     opt_state = init_opt_state(comm, opt, params)
@@ -78,6 +82,33 @@ def test_train_step_compiles_for_every_flavor(flavor):
     batch = (targets.reshape(comm.size, 3),)
     params2, _, loss = step(params, opt_state, batch)
     np.testing.assert_allclose(np.asarray(params2["w"]), 3.5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("flavor", [
+    "naive", "flat", "hierarchical", "two_dimensional", "non_cuda_aware",
+    "xla", "single_node"])
+def test_train_step_compiles_on_one_device_world(flavor):
+    """A 1-device world (the real single-TPU-chip deployment, exercised by
+    tools/tpu_smoke.py) builds a (1, 1) mesh where every collective is an
+    identity — but the variance types still have to be cleared for the
+    replicated out_specs.  single_node once failed exactly here."""
+    from chainermn_tpu.parallel.topology import init_topology
+
+    topo = init_topology(devices=jax.devices()[:1])
+    comm = chainermn_tpu.create_communicator(flavor, topology=topo)
+    assert comm.size == 1
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(1.0), comm, double_buffering=True)
+    params = {"w": jnp.zeros((3,))}
+    opt_state = init_opt_state(comm, opt, params)
+    step = make_train_step(comm, quad_loss, opt, donate=False)
+    batch = (jnp.ones((1, 3)),)
+    params1, opt_state, _ = step(params, opt_state, batch)
+    params2, _, _ = step(params1, opt_state, batch)
+    # double-buffered semantics hold even at world size 1: step 1 applies
+    # zeros, step 2 applies step-1 grads (grad = w - 1 = -1 -> w = 1)
+    np.testing.assert_allclose(np.asarray(params1["w"]), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(params2["w"]), 1.0, rtol=1e-6)
 
 
 class TestDoubleBuffering:

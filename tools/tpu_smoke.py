@@ -28,6 +28,7 @@ Run on the real chip:
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -39,23 +40,18 @@ def log(*a):
 
 
 def _ref_attention(q, k, v, causal):
-    """O(T^2) reference in f32 (GQA-aware)."""
+    """O(T^2) GQA-aware oracle: repeat kv heads, delegate to the tested
+    fp32-stable reference (chainermn_tpu.parallel.sequence.attention);
+    q_offset=Tkv-Tq aligns the causal mask for rectangular shapes."""
     import jax.numpy as jnp
 
-    B, Tq, H, D = q.shape
-    Hkv = k.shape[2]
-    group = H // Hkv
+    from chainermn_tpu.parallel.sequence import attention
+
+    group = q.shape[2] // k.shape[2]
     kf = jnp.repeat(k.astype(jnp.float32), group, axis=2)
     vf = jnp.repeat(v.astype(jnp.float32), group, axis=2)
-    qf = q.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / np.sqrt(D)
-    if causal:
-        Tkv = k.shape[1]
-        mask = (np.arange(Tq)[:, None] + (Tkv - Tq)) >= np.arange(Tkv)[None]
-        s = jnp.where(mask[None, None], s, -1e30)
-    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+    return attention(q.astype(jnp.float32), kf, vf, causal=causal,
+                     q_offset=k.shape[1] - q.shape[1])
 
 
 def check_flash_parity(T=8192, causal=True):
@@ -245,13 +241,27 @@ def main():
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "checks": {},
     }
+    if args.only and args.out and os.path.exists(args.out):
+        # --only re-runs merge into the existing ledger (same backend
+        # only) instead of discarding the other checks' evidence.
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            if prev.get("backend") == backend:
+                doc["checks"] = prev.get("checks", {})
+        except (OSError, ValueError):
+            pass
     if backend != "tpu":
         log("tpu_smoke: WARNING — no TPU attached; running the same checks "
             "on the CPU backend (ledger marked on_tpu=false)")
 
-    selected = (set(args.only.split(",")) if args.only
-                else {n for n, _ in CHECKS})
-    failed = []
+    known = {n for n, _ in CHECKS}
+    selected = set(args.only.split(",")) if args.only else known
+    unknown = selected - known
+    if unknown:
+        # A typo must not produce an empty-but-green evidence ledger.
+        raise SystemExit(f"unknown check(s) {sorted(unknown)}; "
+                         f"available: {sorted(known)}")
     for name, fn in CHECKS:
         if name not in selected:
             continue
@@ -267,9 +277,9 @@ def main():
             doc["checks"][name] = {
                 "ok": False, "wall_s": round(time.perf_counter() - t0, 1),
                 "error": f"{type(e).__name__}: {e}"}
-            failed.append(name)
             log(f"tpu_smoke: {name} FAILED: {type(e).__name__}: {e}")
-    doc["ok"] = not failed
+    doc["ok"] = bool(doc["checks"]) and all(
+        c.get("ok") for c in doc["checks"].values())
 
     blob = json.dumps(doc)
     if args.out:
