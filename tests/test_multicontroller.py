@@ -428,6 +428,30 @@ print("RESULT " + json.dumps({"losses": losses,
 """
 
 
+_TWO_D_WORKER = _FOUR_DP_WORKER.replace(
+    'chainermn_tpu.init_distributed(local_device_count=2)',
+    'chainermn_tpu.init_distributed(local_device_count=4)').replace(
+    'assert jax.process_count() == 4 and jax.device_count() == 8',
+    'assert jax.process_count() == 2 and jax.device_count() == 8').replace(
+    'comm = chainermn_tpu.create_communicator("hierarchical")\n'
+    'assert (comm.inter_size, comm.intra_size) == (4, 2)',
+    'comm = chainermn_tpu.create_communicator("two_dimensional")\n'
+    'assert (comm.inter_size, comm.intra_size) == (2, 4)')
+
+
+@pytest.mark.slow
+def test_two_controller_two_dimensional():
+    """two_dimensional's reduce-scatter/allreduce/gather-back decomposition
+    across REAL controller processes (its inter leg actually crosses the
+    process boundary here — the deployment shape the CPU-mesh tests only
+    emulate)."""
+    results = spawn_world(_TWO_D_WORKER, n_procs=2, local_devices=4,
+                          timeout=600)
+    assert results[0]["losses"] == pytest.approx(results[1]["losses"],
+                                                 rel=1e-6)
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
+
+
 @pytest.mark.slow
 def test_four_controller_training():
     """VERDICT r3 'next #3': the cross-controller fabric beyond its minimum

@@ -1,0 +1,62 @@
+"""Evidence-tool surface tests (tools/tpu_smoke.py, convergence ledger).
+
+The per-round hardware/convergence ledgers are driver-facing artifacts;
+these tests pin the CLI behaviors that keep them trustworthy: typo'd
+check names must fail loudly (an empty-but-green ledger is worse than no
+ledger), and --only re-runs must merge into the existing ledger instead
+of discarding the other checks' evidence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "tpu_smoke.py")
+
+
+def _run(args, timeout=300):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "JAX_NUM_CPU_DEVICES": "1"})
+    return subprocess.run([sys.executable, TOOL] + args, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_unknown_check_rejected(tmp_path):
+    out = tmp_path / "ev.json"
+    r = _run(["--only", "bogus_check", "--out", str(out)])
+    assert r.returncode != 0
+    assert "unknown check" in (r.stderr + r.stdout)
+    assert not out.exists(), "a rejected run must not write a ledger"
+
+
+def test_only_run_merges_into_ledger(tmp_path):
+    out = tmp_path / "ev.json"
+    # Seed a ledger with a fake passing check from the same backend.
+    json.dump({"suite": "tpu_smoke", "backend": "cpu",
+               "checks": {"seeded": {"ok": True}}}, open(out, "w"))
+    r = _run(["--only", "cast_scale", "--out", str(out)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.load(open(out))
+    assert doc["checks"]["cast_scale"]["ok"] is True
+    assert doc["checks"]["seeded"]["ok"] is True, "merge dropped evidence"
+    assert doc["ok"] is True
+
+
+def test_empty_ledger_is_not_green(tmp_path):
+    # doc["ok"] must not be True when nothing ran (all([]) pitfall).
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import importlib
+
+        import tpu_smoke
+
+        importlib.reload(tpu_smoke)
+        assert bool({}) is False  # guard the guard
+        # the ok computation requires a non-empty checks dict
+        src = open(TOOL).read()
+        assert 'bool(doc["checks"]) and all(' in src
+    finally:
+        sys.path.pop(0)
