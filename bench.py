@@ -172,6 +172,24 @@ def run(args) -> dict:
         mfu = per_chip * TRAIN_GFLOP_PER_IMAGE / 1e3 / peak
         out["mfu"] = round(mfu, 4)
         out["step_ms"] = round(dt / steps * 1e3, 2)
+        # Supplementary on-DEVICE per-step time (profiler device track):
+        # separates chip time from the ~10 ms/dispatch host/tunnel term so
+        # the artifact records both (wall stays the official metric).
+        try:
+            from chainermn_tpu.utils.trace import device_time
+
+            box = [(params, model_state, opt_state)]
+
+            def one():
+                p, ms_, os_ = box[0]
+                p, ms_, os_, l = step(p, ms_, os_, batch)
+                box[0] = (p, ms_, os_)
+                return l
+
+            out["device_ms_per_step"] = round(
+                device_time(one, (), steps=3, warmup=1) / scan, 2)
+        except Exception as e:  # noqa: BLE001 — supplementary only
+            log(f"bench: device-time capture skipped ({e})")
         log(f"bench: MFU {mfu:.1%} (peak {peak} TFLOP/s bf16, "
             f"{TRAIN_GFLOP_PER_IMAGE} GFLOP/img train)")
     else:

@@ -45,8 +45,9 @@ def test_only_run_merges_into_ledger(tmp_path):
     assert doc["ok"] is True
 
 
-def test_empty_ledger_is_not_green(tmp_path):
-    # doc["ok"] must not be True when nothing ran (all([]) pitfall).
+def test_empty_ledger_is_not_green(tmp_path, monkeypatch):
+    """A run in which no check executes must exit nonzero with ok=false
+    (the all([])==True pitfall), behaviorally."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:
         import importlib
@@ -54,9 +55,13 @@ def test_empty_ledger_is_not_green(tmp_path):
         import tpu_smoke
 
         importlib.reload(tpu_smoke)
-        assert bool({}) is False  # guard the guard
-        # the ok computation requires a non-empty checks dict
-        src = open(TOOL).read()
-        assert 'bool(doc["checks"]) and all(' in src
+        out = tmp_path / "ev.json"
+        monkeypatch.setattr(tpu_smoke, "CHECKS", [])
+        monkeypatch.setattr(sys, "argv",
+                            ["tpu_smoke.py", "--out", str(out)])
+        rc = tpu_smoke.main()
+        assert rc == 1
+        doc = json.load(open(out))
+        assert doc["ok"] is False and doc["checks"] == {}
     finally:
         sys.path.pop(0)
