@@ -39,21 +39,68 @@ def main():
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line per flavor")
+    parser.add_argument("--scaling", action="store_true",
+                        help="sweep device counts (2, 4, ..., all) per "
+                             "flavor and report scaling efficiency vs the "
+                             "smallest count — the one-command 8->256 "
+                             "table for a real multi-chip slice "
+                             "(north-star metric #2)")
     args = parser.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     import chainermn_tpu
+    from chainermn_tpu.parallel.topology import init_topology
+
+    all_devices = jax.devices()
+    procs = sorted({d.process_index for d in all_devices})
+    per_proc = {p: [d for d in all_devices if d.process_index == p]
+                for p in procs}
+
+    def pick(count):
+        """Device subset of the given size, or None if unusable.
+
+        Multi-controller worlds: every process must own devices in every
+        swept mesh (a mesh missing this process's devices cannot be
+        executed here), so subsets take count/len(procs) devices from
+        EACH process; single-controller worlds take a plain prefix.
+        """
+        if len(procs) == 1:
+            return all_devices[:count]
+        if count % len(procs) or count < len(procs):
+            return None
+        k = count // len(procs)
+        return [d for p in procs for d in per_proc[p][:k]]
+
+    if args.scaling:
+        counts = [c for c in (2 ** k for k in range(1, 12))
+                  if c <= len(all_devices) and pick(c) is not None]
+        if not counts or counts[-1] != len(all_devices):
+            counts.append(len(all_devices))
+    else:
+        counts = [len(all_devices)]
 
     n_elems = int(args.mb * (1 << 20) / np.dtype(args.dtype).itemsize)
     results = []
+    base_busbw = {}
     for name in args.communicators.split(","):
+      for count in counts:
         kwargs = {}
         if args.allreduce_grad_dtype and name in ("xla", "pure_nccl"):
             kwargs["allreduce_grad_dtype"] = args.allreduce_grad_dtype
-        comm = chainermn_tpu.create_communicator(
-            name, intra_size=args.intra_size, **kwargs)
+        if not args.scaling and args.intra_size is not None:
+            kwargs["intra_size"] = args.intra_size
+        try:
+            if args.scaling:
+                kwargs["topology"] = init_topology(
+                    devices=pick(count), intra_size=args.intra_size)
+            comm = chainermn_tpu.create_communicator(name, **kwargs)
+        except ValueError as e:
+            # e.g. hierarchical on a 2-device world with intra=2
+            # (inter=1), or an intra_size that doesn't divide this count
+            print(f"{name}@{count}: skipped ({e})", file=sys.stderr)
+            continue
         n = comm.size
         # one distinct buffer per rank so the collective does real work
         stacked = jnp.tile(
@@ -90,6 +137,15 @@ def main():
                "payload_mib": round(payload / (1 << 20), 1),
                "time_ms": round(dt * 1e3, 3),
                "busbw_gbps": round(busbw, 2)}
+        if args.scaling:
+            # Ring-allreduce bus bandwidth is ideally flat in device
+            # count; efficiency = busbw(n) / busbw(smallest n) is the
+            # scaling-table number (>=0.9 is the BASELINE bar).
+            if name not in base_busbw:
+                base_busbw[name] = (n, busbw)
+            bn, bb = base_busbw[name]
+            row["efficiency_vs"] = bn
+            row["scaling_efficiency"] = round(busbw / bb, 3) if bb else None
         results.append(row)
         if args.json:
             print(json.dumps(row), flush=True)

@@ -564,6 +564,30 @@ print("RESULT " + json.dumps({"losses": losses, "owners": owners,
 """
 
 
+_EIGHT_DP_WORKER = _FOUR_DP_WORKER.replace(
+    'chainermn_tpu.init_distributed(local_device_count=2)',
+    'chainermn_tpu.init_distributed(local_device_count=1)').replace(
+    'assert jax.process_count() == 4 and jax.device_count() == 8',
+    'assert jax.process_count() == 8 and jax.device_count() == 8').replace(
+    'assert (comm.inter_size, comm.intra_size) == (4, 2)',
+    'assert (comm.inter_size, comm.intra_size) == (8, 1)')
+
+
+@pytest.mark.slow
+def test_eight_controller_training():
+    """The reference deployed at arbitrary `mpiexec -n N` 〔SURVEY §0〕;
+    8 controller processes with one device each (inter=8, the all-DCN
+    extreme) is the widest world this host can spawn — loss parity across
+    all 8 pins the control plane + collective fabric well past the
+    2-process minimum."""
+    results = spawn_world(_EIGHT_DP_WORKER, n_procs=8, local_devices=1,
+                          timeout=900)
+    for r in range(1, 8):
+        assert results[r]["losses"] == pytest.approx(results[0]["losses"],
+                                                     rel=1e-6)
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
+
+
 @pytest.mark.slow
 def test_four_controller_chain_fanin_repeated_pairs():
     """4 stages on 4 distinct controller owners, fan-in stage, repeated
