@@ -441,6 +441,16 @@ class Transport {
   // (backpressure via TCP); self-sends never wait (the sender would be
   // waiting on itself).  One message is always admitted once under the
   // mark, so payloads larger than the budget still pass.
+  //
+  // IN-ORDER-CONSUMPTION ASSUMPTION (applies equally to the HWM knob,
+  // CHAINERMN_TPU_INBOX_HWM): if a consumer blocks in recv() on a
+  // (src, tag) frame that sits BEHIND >= HWM bytes of unconsumed frames
+  // on the same connection, the reader thread parks on the budget and
+  // that frame never arrives — recv fails by timeout.  Every collective
+  // in this codebase consumes frames in send order per peer (tags are
+  // issued and awaited monotonically), so the stall cannot occur there;
+  // out-of-order consumers must either drain eagerly or raise the HWM
+  // above their reorder window.  Same shape in PyTransport._enqueue.
   void push(int src, uint32_t tag, Buffer&& payload, bool wait_budget) {
     {
       std::unique_lock<std::mutex> lk(inbox_mutex_);

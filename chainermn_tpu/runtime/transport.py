@@ -38,7 +38,17 @@ _DEFAULT_HWM = 1 << 30
 
 
 def _inbox_hwm() -> int:
-    return int(os.environ.get("CHAINERMN_TPU_INBOX_HWM", _DEFAULT_HWM))
+    # Mirrors the C++ transport's guard: non-numeric or <= 0 values fall
+    # back to the default rather than making the reader-park predicate
+    # (inbox_bytes >= hwm) permanently true and deadlocking every recv.
+    raw = os.environ.get("CHAINERMN_TPU_INBOX_HWM")
+    if raw is None:
+        return _DEFAULT_HWM
+    try:
+        val = int(raw)
+    except ValueError:
+        return _DEFAULT_HWM
+    return val if val > 0 else _DEFAULT_HWM
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

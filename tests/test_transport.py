@@ -296,6 +296,19 @@ class TestGiBScale:
     byte budget (CHAINERMN_TPU_INBOX_HWM) bounds receive-side memory via
     TCP backpressure."""
 
+    @pytest.mark.parametrize("bad", ["0", "-5", "banana", ""])
+    def test_invalid_hwm_env_falls_back(self, bad, monkeypatch):
+        """Non-numeric or <= 0 budgets fall back to the default instead of
+        making the reader-park predicate permanently true (which would
+        deadlock every recv) — mirrors the C++ transport's guard, so the
+        knob behaves identically on both backends (round-4 advisor)."""
+        from chainermn_tpu.runtime.transport import _DEFAULT_HWM, _inbox_hwm
+
+        monkeypatch.setenv("CHAINERMN_TPU_INBOX_HWM", bad)
+        assert _inbox_hwm() == _DEFAULT_HWM
+        monkeypatch.setenv("CHAINERMN_TPU_INBOX_HWM", "4096")
+        assert _inbox_hwm() == 4096
+
     @pytest.mark.parametrize("name,factory", _backends())
     def test_backpressure_bounds_inbox(self, name, factory, monkeypatch):
         hwm = 1 << 20  # 1 MiB budget
