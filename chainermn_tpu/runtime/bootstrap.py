@@ -82,10 +82,23 @@ def init_distributed(
             try:
                 jax.distributed.initialize()
             except RuntimeError as e:
-                # "already initialized" is fine; anything else must NOT be
-                # swallowed — each host silently proceeding as its own
-                # single-controller world would train divergent models.
-                if "already" not in str(e).lower():
+                # "already initialized" is fine; so is "must be called
+                # before any JAX calls" on a SINGLE-host slice (some TPU
+                # platform plugins initialize the backend at interpreter
+                # startup, before user code can run — single-controller
+                # is then exactly the right world).  On a multi-host
+                # slice the same condition must NOT be swallowed: each
+                # host silently proceeding as its own single-controller
+                # world would train divergent models.
+                msg = str(e).lower()
+                hosts = [h for h in os.environ.get(
+                    "TPU_WORKER_HOSTNAMES", "").split(",") if h]
+                single_host = len(hosts) <= 1
+                if "already" in msg:
+                    pass
+                elif "must be called before" in msg and single_host:
+                    pass
+                else:
                     raise
             except Exception as e:
                 import warnings

@@ -346,6 +346,10 @@ def main():
             ["epoch", "iteration", "main/loss", "main/accuracy",
              "validation/loss", "validation/accuracy", "elapsed_time"]))
     trainer.run()
+    if comm.rank == 0:
+        lr = trainer.get_extension("LogReport")
+        final = lr.log[-1] if lr.log else {}
+        print(f"final: {final}")
 
 
 if __name__ == "__main__":

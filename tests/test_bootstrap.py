@@ -33,6 +33,41 @@ def test_no_metadata_means_not_tpu(monkeypatch):
     assert not _tpu_metadata_present()
 
 
+def test_preinitialized_backend_single_host_is_benign(monkeypatch):
+    """Round-5 on-chip finding: platform plugins that initialize the XLA
+    backend at interpreter startup (sitecustomize) make the no-arg
+    ``jax.distributed.initialize()`` raise 'must be called before any JAX
+    calls'.  On a SINGLE-host slice that is benign (single-controller is
+    the correct world); on a multi-host slice it must still raise."""
+    import unittest.mock as mock
+
+    from chainermn_tpu.runtime.bootstrap import init_distributed
+
+    err = RuntimeError(
+        "jax.distributed.initialize() must be called before any JAX calls "
+        "that might initialise the XLA backend.")
+    for v in ("CHAINERMN_TPU_COORDINATOR", "CHAINERMN_TPU_NUM_PROCESSES",
+              "CHAINERMN_TPU_PROCESS_ID"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+
+    # single host: swallowed
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    with mock.patch("jax.distributed.initialize", side_effect=err):
+        init_distributed()  # must not raise
+
+    # multi host: the same condition is a hard error (silent divergence)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b")
+    with mock.patch("jax.distributed.initialize", side_effect=err):
+        with pytest.raises(RuntimeError):
+            init_distributed()
+
+    # 'already initialized' stays benign on any world
+    with mock.patch("jax.distributed.initialize",
+                    side_effect=RuntimeError("already initialized")):
+        init_distributed()
+
+
 def test_cpu_platform_suppresses_pod_path(monkeypatch):
     """Even with TPU metadata present, an explicit JAX_PLATFORMS=cpu run
     (the test environment itself) must stay single-controller."""

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Hardware-day runbook — the EXACT ordered commands for the first session
+# with a real multi-chip TPU slice (round-4 judge 'next #6'; NEXT.md
+# round-5 candidate #1).  Each step names the artifact it must produce so
+# real hardware time burns zero minutes on rediscovery.
+#
+#   ./tools/multichip_day1.sh            # run everything possible here
+#   DRY_RUN=1 ./tools/multichip_day1.sh  # print the plan, run nothing
+#
+# On a host WITHOUT a multi-chip slice every multi-chip step prints
+# "SKIPPED (no hardware)" and the single-chip steps still run, so the
+# script itself is exercised (and CI-checkable) before the day arrives.
+set -u
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+TS="$(date -u +%Y%m%dT%H%M%S)"
+OUT="${OUT:-$REPO/hwday_$TS}"
+ROUND="${ROUND:-r05}"
+PY_TPU="env PYTHONPATH=/root/.axon_site:$REPO python"
+DRY="${DRY_RUN:-0}"
+
+# How many TPU devices does this host actually see?
+NDEV=$($PY_TPU -c 'import jax; print(sum(1 for d in jax.devices() if d.platform != "cpu"))' 2>/dev/null || echo 0)
+echo "== multichip day-1 runbook: $NDEV TPU device(s) visible =="
+[ "$DRY" = 1 ] || mkdir -p "$OUT"
+
+run() {  # run <min_devices> <artifact> <desc> -- cmd...
+    local need="$1" artifact="$2" desc="$3"; shift 3; shift  # drop '--'
+    echo
+    echo "== $desc"
+    echo "   artifact: $artifact"
+    echo "   command:  $*"
+    if [ "$DRY" = 1 ]; then echo "   DRY_RUN: not executed"; return 0; fi
+    if [ "$NDEV" -lt "$need" ]; then
+        echo "   SKIPPED (no hardware: need >= $need TPU devices, have $NDEV)"
+        return 0
+    fi
+    if "$@"; then echo "   OK"; else echo "   FAILED (continuing — record it)"; fi
+}
+
+# ---- single-chip steps (run today, re-run on the slice for parity) ----
+
+run 1 "$OUT/TPU_EVIDENCE_$ROUND.json" \
+    "tpu_smoke: the full on-chip evidence suite" -- \
+    $PY_TPU tools/tpu_smoke.py --out "$OUT/TPU_EVIDENCE_$ROUND.json"
+
+run 1 "$OUT/CONVERGENCE_$ROUND.json" \
+    "convergence ledger ON THE CHIP (bf16 numerics are the point)" -- \
+    $PY_TPU tools/convergence_ledger.py --out "$OUT/CONVERGENCE_$ROUND.json"
+
+run 1 "$OUT/BENCH_$ROUND.json" \
+    "headline ResNet-50 bench (driver-official format)" -- \
+    bash -c "$PY_TPU bench.py > '$OUT/BENCH_$ROUND.json'"
+
+# ---- THE two hardware-blocked numbers (north-star metric #2) ----------
+
+run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \
+    "8->N allreduce scaling table (the headline hardware-day number): busbw per flavor per device count; >=0.9 scaling efficiency is the BASELINE bar" -- \
+    bash -c "$PY_TPU benchmarks/bench_allreduce.py --scaling --json \
+        --mb 64 --communicators xla,hierarchical,two_dimensional \
+        > '$OUT/ALLREDUCE_SCALING_$ROUND.json'"
+
+run 2 "$OUT/DB_OVERLAP_$ROUND.json" \
+    "double-buffer combiner/barrier split check on REAL chips (docs/performance.md 'pending hardware validation': two collectives in the TPU schedule, grads AR overlapping fwd)" -- \
+    $PY_TPU tools/check_db_overlap.py --out "$OUT/DB_OVERLAP_$ROUND.json"
+
+# ---- full-shape configs on the slice ----------------------------------
+
+run 4 "$OUT/RUN_CONFIGS_$ROUND.json" \
+    "five BASELINE configs at full shape (repeat-median discipline)" -- \
+    $PY_TPU benchmarks/run_configs.py --out "$OUT/RUN_CONFIGS_$ROUND.json"
+
+run 8 "$OUT/RING_FLASH_$ROUND.json" \
+    "ring attention x flash across real chips (sequence parallelism on ICI)" -- \
+    bash -c "$PY_TPU benchmarks/bench_ring_attention.py --json > '$OUT/RING_FLASH_$ROUND.json'"
+
+run 2 "$OUT/MULTICONTROLLER_$ROUND.txt" \
+    "multi-controller worlds on real hardware (2/4/8-proc DP parity + 4-owner pipeline)" -- \
+    bash -c "cd $REPO && python -m pytest tests/test_multicontroller.py -q | tee '$OUT/MULTICONTROLLER_$ROUND.txt'"
+
+echo
+echo "== runbook complete; artifacts (if any) under $OUT =="
