@@ -391,7 +391,8 @@ def _seq2seq_stage_times_onchip():
 
     ms = device_time(enc_fn, (), steps=10, warmup=2)
     out["encoder"] = {"device_ms_per_step": round(ms, 3),
-                      "tokens_per_sec": round(batch * seq_len / ms * 1e3, 1)}
+                      "tokens_per_sec": round(batch * seq_len / ms * 1e3, 1)
+                      if ms > 0 else None}
 
     dec = Seq2SeqDecoder(vocab, hidden=hidden)
     carry = jax.lax.stop_gradient(enc.apply(enc_params, src))
@@ -419,7 +420,8 @@ def _seq2seq_stage_times_onchip():
 
     ms = device_time(dec_fn, (), steps=10, warmup=2)
     out["decoder"] = {"device_ms_per_step": round(ms, 3),
-                      "tokens_per_sec": round(batch * seq_len / ms * 1e3, 1)}
+                      "tokens_per_sec": round(batch * seq_len / ms * 1e3, 1)
+                      if ms > 0 else None}
     return out
 
 
