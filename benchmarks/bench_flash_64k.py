@@ -137,19 +137,22 @@ def main():
         w0 = jax.jit(lambda k: jax.random.normal(
             k, (D, D), jnp.float32) * 0.05)(jax.random.key(1))
 
-        def loss(w, a, b, c):
+        # gg as an explicit argument: closure-captured device arrays are
+        # embedded as constants in the remote-compile request (the
+        # round-5 T=262144 413); explicit args travel as references.
+        def loss(w, a, b, c, gg):
             o = flash_attention(a @ w.astype(a.dtype), b, c, causal=True)
             return jnp.sum(
-                o.astype(jnp.float32) * g2.astype(jnp.float32)) / T
+                o.astype(jnp.float32) * gg.astype(jnp.float32)) / T
 
         @jax.jit
-        def step(w, a, b, c):
-            l, gw = jax.value_and_grad(loss)(w, a, b, c)
+        def step(w, a, b, c, gg):
+            l, gw = jax.value_and_grad(loss)(w, a, b, c, gg)
             return w - 0.1 * gw, l
 
         w, losses = w0, []
         for _ in range(3):
-            w, l = step(w, q2, k2, v2)
+            w, l = step(w, q2, k2, v2, g2)
             losses.append(float(l))
         delta = float(jnp.linalg.norm(w - w0))
         assert delta > 0.0, "zero weight update — broken backward"

@@ -183,18 +183,22 @@ def check_flash_train_T64k(T=65536):
     w0 = jax.jit(lambda kk: jax.random.normal(
         kk, (D, D), jnp.float32) * 0.05)(jax.random.key(1))
 
-    def loss(w, a, b, c):
+    # g is an EXPLICIT jit argument, not a closure capture: captured
+    # device arrays are embedded as constants in the remote-compile
+    # request on this platform (~268 MB at T=262144 — the round-5 413),
+    # while explicit arguments travel as buffer references.
+    def loss(w, a, b, c, gg):
         o = flash_attention(a @ w.astype(a.dtype), b, c, causal=True)
-        return jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32)) / T
+        return jnp.sum(o.astype(jnp.float32) * gg.astype(jnp.float32)) / T
 
     @jax.jit
-    def train(w, a, b, c):
-        l, gw = jax.value_and_grad(loss)(w, a, b, c)
+    def train(w, a, b, c, gg):
+        l, gw = jax.value_and_grad(loss)(w, a, b, c, gg)
         return w - 0.1 * gw, l
 
     w, losses = w0, []
     for _ in range(3):
-        w, l = train(w, q, k, v)
+        w, l = train(w, q, k, v, g)
         losses.append(float(l))
     delta = float(jnp.linalg.norm(w - w0))
     assert all(np.isfinite(l) for l in losses), \
