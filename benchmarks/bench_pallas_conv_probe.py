@@ -202,6 +202,50 @@ def main():
                                   "device_ms": round(best[2], 3)}
         doc["cases"][name] = row
 
+    # The 3x3 64->64 conv at 56^2 — where the probe's matmul result says
+    # the in-step deficit must live.  No Pallas contender here (the
+    # matmul cases above bound what a hand kernel achieves on far
+    # simpler access patterns); this pins XLA's number against the
+    # 64-lane compute ceiling (~98 TFLOP/s = half the 197 peak) so the
+    # stage-1 attribution is measured, not inferred.
+    def conv_case(name, fwd_only=False):
+        B, HW, C = 256, 56, 64
+        x = alloc(2, (B, HW, HW, C))
+        w = alloc(3, (3, 3, C, C))
+
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.bfloat16)
+
+        if fwd_only:
+            fn = jax.jit(conv)
+            flops = 2 * B * HW * HW * 9 * C * C
+        else:
+            def fwdbwd(x, w):
+                def loss(x, w):
+                    return jnp.sum(conv(x, w).astype(jnp.float32) ** 2)
+                gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+                return gx, gw
+
+            fn = jax.jit(fwdbwd)
+            flops = 3 * 2 * B * HW * HW * 9 * C * C  # fwd + dgrad + wgrad
+
+        def run():
+            ms = device_time(fn, (x, w), steps=5, warmup=2)
+            return {"device_ms": round(ms, 3),
+                    "tflops": round(flops / (ms / 1e3) / 1e12, 1)}
+
+        row = retry_transient(run, attempts=3, label=name)
+        row["flops_g"] = round(flops / 1e9, 1)
+        row["lane_ceiling_tflops"] = 98.5  # 64 of 128 MXU lanes at 197 peak
+        doc["cases"][name] = row
+        log(f"{name}: {row}")
+
+    conv_case("conv3x3_fwd", fwd_only=True)
+    conv_case("conv3x3_fwd_bwd")
+
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)

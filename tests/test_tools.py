@@ -45,6 +45,56 @@ def test_only_run_merges_into_ledger(tmp_path):
     assert doc["ok"] is True
 
 
+def test_multichip_day1_dry_run():
+    """The hardware-day runbook (round-5): DRY_RUN=1 prints every step
+    with its artifact and command, executes nothing, exits 0 — so the
+    runbook itself cannot rot before hardware day."""
+    env = dict(os.environ, DRY_RUN="1")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "multichip_day1.sh")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    for step in ("tpu_smoke", "convergence ledger", "allreduce scaling",
+                 "combiner/barrier split", "five BASELINE configs",
+                 "ring attention", "multi-controller"):
+        assert step in out, f"runbook lost its '{step}' step:\n{out}"
+    assert out.count("DRY_RUN: not executed") >= 7, out
+    assert "artifact:" in out
+
+
+def test_check_db_overlap_cpu_verdict(tmp_path, devices):
+    """On the 8-device CPU mesh the db-overlap checker must exit 0 and
+    reach its documented CPU-side verdict (merged form: the CPU pipeline
+    erases the optimization_barrier before the combiner runs —
+    docs/performance.md) with a non-empty collectives list."""
+    out = tmp_path / "db.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_db_overlap.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                 JAX_NUM_CPU_DEVICES="8"))
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:])
+    doc = json.loads(out.read_text())
+    assert doc["backend"] == "cpu" and doc["n_devices"] == 8
+    assert doc["collectives"], doc
+    assert "verdict" in doc
+
+
+def test_convergence_ledger_rejects_unknown_check():
+    """A typo must not produce an empty-but-green convergence ledger
+    (same guard as tpu_smoke --only)."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "convergence_ledger.py"),
+         "--only", "no_such_check"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"))
+    assert r.returncode != 0
+    assert "unknown check" in (r.stdout + r.stderr)
+
+
 def test_empty_ledger_is_not_green(tmp_path, monkeypatch):
     """A run in which no check executes must exit nonzero with ok=false
     (the all([])==True pitfall), behaviorally."""
