@@ -292,6 +292,40 @@ def check_train_step_flavors():
                     "HLO census (bench_allreduce --census)."}
 
 
+def check_flash_bwd_throughput(T=32768):
+    """Backward-pass device throughput at T=32768 — completes the kernel
+    ledger (fwd rates were pinned rounds 3-5; the training claims rest
+    on the backward too).  FLOP accounting: the streaming backward does
+    5 block matmuls (score recompute, dv, dp, dq, dk) vs the forward's
+    2, so bwd FLOPs = 2.5x fwd."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.ops.flash_attention import flash_attention
+    from chainermn_tpu.utils.trace import device_time
+
+    B, H, D = 1, 4, 128
+    mk = jax.jit(lambda k: tuple(
+        jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+        for kk in jax.random.split(k, 4)))
+    q, k, v, g = mk(jax.random.key(3))
+
+    def loss(a, b, c, gg):
+        o = flash_attention(a, b, c, causal=True)
+        return jnp.sum(o.astype(jnp.float32) * gg.astype(jnp.float32))
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    ms = device_time(grad_fn, (q, k, v, g), steps=5, warmup=2)
+    fwd_flops = 2 * 2 * B * H * (T * T / 2) * D
+    # grad-of-loss runs fwd (for the residuals actually saved: here the
+    # custom_vjp forward) + the 5-matmul backward = 2 + 5 block matmuls
+    flops = (2 + 5) / 2 * fwd_flops
+    tflops = round(flops / (ms / 1e3) / 1e12, 1) if ms > 0 else None
+    return {"T": T, "device_ms": round(ms, 2), "tflops_fwd_plus_bwd": tflops,
+            "flop_accounting": "7 block-matmuls (2 fwd + 5 bwd) x "
+                               "B*H*T^2/2*D*2"}
+
+
 def check_flash_train_T256k():
     """T=262144 demonstrative training step (round-4 judge 'next #8') on
     the device-resident-operand path — 4x the round-4 headline, ~70
@@ -308,6 +342,7 @@ CHECKS = [
     ("flash_parity_T8k", check_flash_parity),
     ("flash_gqa_rectangular", check_gqa_rectangular),
     ("flash_throughput_T32k", check_flash_throughput),
+    ("flash_bwd_T32k", check_flash_bwd_throughput),
     ("flash_train_T64k", check_flash_train_T64k),
     ("flash_train_T256k", check_flash_train_T256k),
     ("cast_scale", check_cast_scale),
