@@ -14,6 +14,7 @@ from chainermn_tpu.models.resnet import (
 )
 from chainermn_tpu.models.transformer import TransformerLM
 from chainermn_tpu.models.vgg import VGG, VGG16
+from chainermn_tpu.models.vit import ViT, ViT_B16, ViT_S16
 
 __all__ = [
     "TransformerLM",
@@ -32,4 +33,7 @@ __all__ = [
     "ResNet152",
     "VGG",
     "VGG16",
+    "ViT",
+    "ViT_S16",
+    "ViT_B16",
 ]

@@ -1,0 +1,150 @@
+"""Vision Transformer — the MXU-shaped image classifier.
+
+**Beyond-reference extension** (the reference's model zoo is 2017 ImageNet
+convnets + an LSTM seq2seq — SURVEY.md §2.6; ViT postdates it).  It exists
+for a measured reason: the reference's flagship ResNet-50 is memory-bound
+on TPU (14.7% MFU at the practical ceiling — docs/performance.md pins the
+floor from every side), because its early stages are 64/128-channel convs
+that under-fill the 128-lane MXU.  A ViT of the same parameter class is
+almost entirely large matmuls, i.e. exactly what the MXU is built for —
+so it demonstrates the framework's compute ceiling on the same
+data-parallel machinery (`create_communicator` → `make_train_step`) the
+convnets use.  `benchmarks/bench_vit.py` measures it on-chip.
+
+Architecture (standard ViT, Dosovitskiy et al. 2020): patchify via a
+stride-``patch`` conv, prepend a learned [CLS] token (or mean-pool with
+``pooling="gap"``), learned position embeddings, pre-LN encoder blocks
+(non-causal self-attention + GELU MLP), classify from the final LN'd
+[CLS] row.  bf16-capable with f32 parameters, like the rest of the zoo.
+
+``attention_impl`` is pluggable like :class:`TransformerLM`'s: ``"xla"``
+(default — at 197 tokens the unfused math is a fine single fusion) or
+``"flash"`` (the Pallas kernel; the whole sequence fits one tile).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _encoder_attention(impl: str, q, k, v):
+    if impl == "flash":
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=False)
+    if impl == "xla":
+        from chainermn_tpu.parallel.sequence import attention
+
+        return attention(q, k, v, causal=False)
+    raise ValueError(f"attention_impl must be xla|flash, got {impl!r}")
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN non-causal transformer encoder block (attention + GELU MLP)."""
+
+    n_heads: int
+    mlp_ratio: int = 4
+    attention_impl: str = "xla"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.n_heads
+        dense = lambda f, name: nn.Dense(
+            f, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        ln = lambda name: nn.LayerNorm(dtype=self.dtype,
+                                       param_dtype=jnp.float32, name=name)
+        drop = lambda h: nn.Dropout(self.dropout, deterministic=not train)(h)
+
+        h = ln("ln_attn")(x)
+        qkv = dense(3 * d_model, "qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = h.shape[:-1] + (self.n_heads, head_dim)
+        out = _encoder_attention(
+            self.attention_impl, q.reshape(shape), k.reshape(shape),
+            v.reshape(shape))
+        x = x + drop(dense(d_model, "proj")(out.reshape(h.shape)))
+
+        h = ln("ln_mlp")(x)
+        h = nn.gelu(dense(self.mlp_ratio * d_model, "up")(h))
+        return x + drop(dense(d_model, "down")(drop(h)))
+
+
+class ViT(nn.Module):
+    """``apply({"params": p}, images[B, H, W, 3], train=...) ->
+    logits[B, num_classes]`` — same calling convention as the conv zoo
+    (no BatchNorm state; LayerNorm throughout)."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_ratio: int = 4
+    pooling: str = "cls"          # "cls" token or "gap" mean pooling
+    attention_impl: str = "xla"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must divide d_model "
+                f"({self.d_model})")
+        if x.shape[1] % self.patch or x.shape[2] % self.patch:
+            raise ValueError(
+                f"image size {x.shape[1]}x{x.shape[2]} must be a multiple "
+                f"of the patch size ({self.patch})")
+        if self.pooling not in ("cls", "gap"):
+            raise ValueError(f"pooling must be cls|gap, got {self.pooling!r}")
+        x = x.astype(self.dtype)
+        # patchify: one stride-`patch` conv == per-patch linear projection
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(x)
+        b = x.shape[0]
+        x = x.reshape(b, -1, self.d_model)
+        if self.pooling == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, self.d_model), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(
+                    self.dtype), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.d_model), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.n_layers):
+            x = EncoderBlock(self.n_heads, self.mlp_ratio,
+                             self.attention_impl, self.dropout, self.dtype,
+                             name=f"block_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        x = x[:, 0] if self.pooling == "cls" else x.mean(axis=1)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def ViT_S16(**kw):
+    """ViT-Small/16: 384 wide, 12 layers, 6 heads (~22M params)."""
+    kw.setdefault("d_model", 384)
+    kw.setdefault("n_layers", 12)
+    kw.setdefault("n_heads", 6)
+    return ViT(**kw)
+
+
+def ViT_B16(**kw):
+    """ViT-Base/16: 768 wide, 12 layers, 12 heads (~86M params)."""
+    return ViT(**kw)
+
+
+__all__ = ["EncoderBlock", "ViT", "ViT_S16", "ViT_B16"]

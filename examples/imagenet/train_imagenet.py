@@ -35,7 +35,7 @@ from chainermn_tpu.extensions import (
     create_multi_node_evaluator, make_eval_fn)
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models import (
-    AlexNet, GoogLeNet, GoogLeNetBN, NIN, ResNet50)
+    AlexNet, GoogLeNet, GoogLeNetBN, NIN, ResNet50, ViT_B16, ViT_S16)
 from chainermn_tpu.optimizers import (
     init_model_state, init_opt_state, make_train_step)
 from chainermn_tpu.training import (
@@ -47,6 +47,9 @@ ARCHS = {
     "googlenetbn": (GoogLeNetBN, True),
     "nin": (NIN, False),
     "resnet50": (ResNet50, True),
+    # beyond-reference: MXU-shaped classifiers (models/vit.py docstring)
+    "vit_s16": (ViT_S16, False),
+    "vit_b16": (ViT_B16, False),
 }
 
 

@@ -16,13 +16,13 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=420):
+def _run(script, *args, timeout=420, base="examples"):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_NUM_CPU_DEVICES"] = "8"
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        [sys.executable, os.path.join(REPO, base, script), *args],
         capture_output=True, text=True, timeout=timeout, env=env)
     assert proc.returncode == 0, (
         f"{script} failed\nstdout:\n{proc.stdout[-2000:]}\n"
@@ -180,3 +180,25 @@ def test_imagenet_zero_optimizer(tmp_path):
                "--n-classes", "10", "--dtype", "float32", "--zero",
                "--out", str(tmp_path))
     assert "loss" in out.lower() or "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_imagenet_vit(tmp_path):
+    """--arch vit_s16 trains through the stock ImageNet script (the
+    MXU-shaped beyond-reference family, models/vit.py)."""
+    out = _run("imagenet/train_imagenet.py",
+               "--arch", "vit_s16", "--epoch", "1", "--batchsize", "16",
+               "--train-size", "64", "--image-size", "32",
+               "--n-classes", "10", "--dtype", "float32",
+               "--out", str(tmp_path))
+    assert "loss" in out.lower() or "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_bench_vit_contract():
+    """bench_vit.py emits its one-JSON-line contract on any backend."""
+    import json
+
+    stdout = _run("bench_vit.py", base="benchmarks")
+    out = json.loads(stdout.strip().splitlines()[-1])
+    assert out["unit"] == "images/sec/chip" and out["value"] > 0

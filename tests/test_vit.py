@@ -1,0 +1,133 @@
+"""ViT model-family tests — structure, dtype policy, pooling, attention
+impls, and the full multi-node train-step path at tiny widths on the CPU
+mesh (same strategy as test_models.py; the model is a beyond-reference
+extension, see chainermn_tpu/models/vit.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.models import ViT, ViT_B16, ViT_S16
+from chainermn_tpu.optimizers import init_opt_state, make_train_step
+from chainermn_tpu.training import put_global_batch
+
+TinyViT = lambda **kw: ViT(num_classes=5, patch=8, d_model=32, n_layers=2,
+                           n_heads=4, **kw)
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("flat")
+
+
+class TestStructure:
+    def test_vit_b16_param_count(self):
+        # init on 64x64 to keep CPU time down: parameter count differs from
+        # the 224-image model only in pos_embed (17 vs 197 rows)
+        model = ViT_B16(num_classes=1000)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+        n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+        assert 80e6 < n < 92e6, f"ViT-B/16 should have ~86M params, got {n}"
+
+    def test_vit_s16_param_count(self):
+        model = ViT_S16(num_classes=1000)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+        n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+        assert 19e6 < n < 24e6, f"ViT-S/16 should have ~22M params, got {n}"
+
+    def test_forward_shape_and_dtype(self):
+        model = TinyViT()
+        variables = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        logits = model.apply(variables, jnp.ones((2, 32, 32, 3)))
+        assert logits.shape == (2, 5)
+        assert logits.dtype == jnp.float32
+
+    def test_bf16_compute_fp32_params(self):
+        model = TinyViT(dtype=jnp.bfloat16)
+        variables = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        for leaf in jax.tree.leaves(variables["params"]):
+            assert leaf.dtype == jnp.float32
+        logits = model.apply(variables, jnp.ones((2, 32, 32, 3)))
+        assert logits.dtype == jnp.float32
+
+    def test_gap_pooling(self):
+        model = TinyViT(pooling="gap")
+        variables = model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        # no cls token parameter in the gap variant
+        assert "cls_token" not in variables["params"]
+        assert variables["params"]["pos_embed"].shape == (1, 16, 32)
+        logits = model.apply(variables, jnp.ones((2, 32, 32, 3)))
+        assert logits.shape == (2, 5)
+
+    def test_bad_config_raises(self):
+        with pytest.raises(ValueError, match="must divide"):
+            ViT(num_classes=5, patch=8, d_model=32, n_layers=2,
+                n_heads=5).init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        with pytest.raises(ValueError, match="multiple"):
+            TinyViT().init(jax.random.key(0), jnp.zeros((1, 30, 30, 3)))
+        with pytest.raises(ValueError, match="pooling"):
+            TinyViT(pooling="max").init(jax.random.key(0),
+                                        jnp.zeros((1, 32, 32, 3)))
+
+    def test_dropout_train_vs_eval(self):
+        model = TinyViT(dropout=0.5)
+        variables = model.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            jnp.zeros((2, 32, 32, 3)), train=True)
+        x = jnp.ones((2, 32, 32, 3))
+        # eval is deterministic and needs no rng
+        e1 = model.apply(variables, x, train=False)
+        e2 = model.apply(variables, x, train=False)
+        np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+        # train with different dropout keys differs
+        t1 = model.apply(variables, x, train=True,
+                         rngs={"dropout": jax.random.key(2)})
+        t2 = model.apply(variables, x, train=True,
+                         rngs={"dropout": jax.random.key(3)})
+        assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+
+class TestAttentionImpls:
+    def test_flash_matches_xla(self):
+        # same params, both impls: logits agree (flash runs in Pallas
+        # interpret mode on the CPU backend — same code path as TPU)
+        mx = TinyViT(attention_impl="xla")
+        mf = TinyViT(attention_impl="flash")
+        variables = mx.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+        yx = mx.apply(variables, x)
+        yf = mf.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(yx), np.asarray(yf),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestTrainStep:
+    def test_loss_decreases_multi_node(self, comm):
+        model = TinyViT()
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        params = comm.bcast_data(variables["params"])
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            optax.adam(1e-3), comm)
+        opt_state = init_opt_state(comm, optimizer, params)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply({"params": p}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        step = make_train_step(comm, loss_fn, optimizer)
+        x = np.random.RandomState(0).randn(
+            comm.size * 2, 32, 32, 3).astype(np.float32)
+        y = (np.arange(comm.size * 2) % 5).astype(np.int32)
+        x += y.reshape(-1, 1, 1, 1) * 0.5   # learnable signal
+        batch = put_global_batch(comm, (x, y))
+        losses = []
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
