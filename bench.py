@@ -36,22 +36,13 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 125.0  # P100, arXiv:1711.04325 (BASELINE.md)
 # ~3x forward (fwd + 2x-cost bwd) ~= 12.3 GFLOP/image (standard accounting,
 # e.g. the MLPerf resnet reference).  Used only for the MFU report.
 TRAIN_GFLOP_PER_IMAGE = 12.3
-PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0,   # bf16 peak
-               "tpu v4": 275.0, "tpu v6 lite": 918.0, "tpu v6e": 918.0}
 
 # Transient-vs-deterministic failure classification and the bounded-retry
 # loop live in chainermn_tpu.utils.retry (shared with tools/tpu_smoke.py).
 # The round-2 loss was "remote_compile: response body closed before all
 # bytes were read".
 from chainermn_tpu.utils.retry import retry_transient  # noqa: E402
-
-
-def _peak_tflops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for k, v in PEAK_TFLOPS.items():
-        if k in kind:
-            return v
-    return 197.0  # assume v5e-class when the kind string is unrecognized
+from chainermn_tpu.utils.tpu_info import peak_tflops as _peak_tflops  # noqa: E402
 
 
 def log(*a):

@@ -32,9 +32,6 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0,
-               "tpu v4": 275.0, "tpu v6 lite": 918.0, "tpu v6e": 918.0}
-
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -137,8 +134,9 @@ def run(args) -> dict:
         "train_gflop_per_image": round(gflop, 4),
     }
     if on_tpu:
-        kind = getattr(jax.devices()[0], "device_kind", "").lower()
-        peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
+        from chainermn_tpu.utils.tpu_info import peak_tflops
+
+        peak = peak_tflops(jax.devices()[0])
         out["mfu"] = round(per_chip * gflop / 1e3 / peak, 4)
         out["step_ms"] = round(dt / steps * 1e3, 2)
         try:

@@ -134,7 +134,12 @@ class _Zero1Optimizer:
     wire dtype, cast back before the inner update.  Inner optimizers
     whose ``init`` depends on parameter VALUES (not just shapes/dtypes)
     are unsupported — every standard optax rule
-    (sgd/momentum/adam/adamw/...) initializes from shapes.
+    (sgd/momentum/adam/adamw/...) initializes from shapes.  Layer-wise
+    rules whose UPDATE depends on per-leaf structure (LARS/LAMB trust
+    ratios) are also out: the flat per-dtype shards erase leaf
+    boundaries, so the "layer-wise" norms would be shard-wise — silently
+    different semantics (the ImageNet example rejects --zero with
+    --optimizer lars for this reason).
     """
 
     def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
@@ -251,6 +256,7 @@ def make_train_step(
     donate: bool = True,
     with_model_state: bool = False,
     scan_steps: int = 1,
+    accum_steps: int = 1,
 ):
     """Build the canonical jitted SPMD train step (the hot loop of SURVEY.md
     §3.2): per-device forward/backward on the local batch shard -> explicit
@@ -272,6 +278,26 @@ def make_train_step(
     benchmarking / synthetic-data loops; real input pipelines feed a fresh
     batch per step and use ``scan_steps=1``.
 
+    ``accum_steps=K`` (K > 1) — gradient accumulation: each device splits
+    its local batch shard into K equal microbatches, runs forward/backward
+    per microbatch under ``lax.scan``, and averages the K gradients before
+    the ONE allreduce + optimizer update.  Because every microbatch loss
+    is a mean over an equal slice, the averaged gradient equals the
+    full-shard gradient exactly — same numerics as ``accum_steps=1`` (the
+    parity test pins it bitwise-close), with peak activation memory
+    divided by ~K.  That is the knob's purpose: fitting a reference
+    global batch on fewer/smaller chips.  The exactness claim is scoped
+    to batch-DECOMPOSABLE losses (a mean of independent per-sample
+    terms).  Two caveats: (a) BatchNorm breaks decomposability — each
+    microbatch normalizes over its own b/K samples, so the forward
+    activations AND gradients differ from the full-shard computation
+    (ghost-batch-norm semantics; smaller effective normalization batch),
+    and the running statistics likewise update K times per step; (b) on
+    TPU the scan body pins conv weight layouts
+    (measured ~1.5x emitter regression for conv nets —
+    docs/performance.md), so use it when memory demands it, not for
+    speed.
+
     ``with_model_state=True`` adds a non-trainable mutable model state slot
     (flax ``batch_stats``) that stays **device-local** — the reference trains
     BatchNorm on local statistics and only syncs via ``AllreducePersistent``
@@ -283,6 +309,8 @@ def make_train_step(
     ``step(params, model_state, opt_state, batch) ->
     (params, model_state, opt_state, loss[, aux])``.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     comm = communicator
     axes = comm.data_axes
     state_spec = _resolve_spec(
@@ -310,14 +338,58 @@ def make_train_step(
         params_local = jax.tree.map(lambda p: pvary(p, axes), params)
         grad_fn = jax.value_and_grad(
             loss_fn, has_aux=has_aux or with_model_state)
-        if with_model_state:
-            (loss, packed), grads = grad_fn(params_local, model_state, batch)
-            model_state, aux = packed if has_aux else (packed, None)
-        elif has_aux:
-            (loss, aux), grads = grad_fn(params_local, batch)
+
+        def compute(model_state, batch):
+            if with_model_state:
+                (loss, packed), grads = grad_fn(
+                    params_local, model_state, batch)
+                model_state, aux = packed if has_aux else (packed, None)
+            elif has_aux:
+                (loss, aux), grads = grad_fn(params_local, batch)
+            else:
+                loss, grads = grad_fn(params_local, batch)
+                aux = None
+            return loss, aux, model_state, grads
+
+        if accum_steps > 1:
+            b_local = jax.tree.leaves(batch)[0].shape[0]
+            if b_local % accum_steps:
+                raise ValueError(
+                    f"accum_steps ({accum_steps}) must divide the "
+                    f"per-device batch ({b_local})")
+            micro = jax.tree.map(
+                lambda a: a.reshape((accum_steps, b_local // accum_steps)
+                                    + a.shape[1:]), batch)
+
+            def body(carry, mb):
+                ms, g_acc, loss_acc, aux_acc = carry
+                loss, aux, ms, grads = compute(ms, mb)
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                aux_acc = (jax.tree.map(jnp.add, aux_acc, aux)
+                           if has_aux else aux_acc)
+                return (ms, g_acc, loss_acc + loss, aux_acc), None
+
+            # accumulators start as zeros shaped like one microbatch's
+            # grads/aux; eval_shape traces abstractly (no extra compile)
+            shapes = jax.eval_shape(
+                lambda: compute(model_state,
+                                jax.tree.map(lambda a: a[0], micro)))
+            # accumulators must carry the body outputs' varying axes
+            # (grads/loss of the pvaried params are device-varying)
+            zeros_varying = lambda t: jax.tree.map(
+                lambda s: pvary(jnp.zeros(s.shape, s.dtype), axes), t)
+            g0 = zeros_varying(shapes[3])
+            a0 = zeros_varying(shapes[1]) if has_aux else None
+            l0 = pvary(jnp.zeros((), jnp.float32), axes)
+            (model_state, grads, loss, aux), _ = jax.lax.scan(
+                body, (model_state, g0, l0, a0), micro)
+            k = jnp.float32(accum_steps)
+            grads = jax.tree.map(lambda g: g / k.astype(g.dtype), grads)
+            loss = loss / k
+            if has_aux:
+                aux = jax.tree.map(lambda a: a / k.astype(a.dtype), aux)
         else:
-            loss, grads = grad_fn(params_local, batch)
-            aux = None
+            loss, aux, model_state, grads = compute(model_state, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if isinstance(opt_state, _DoubleBufferState):

@@ -1,0 +1,32 @@
+"""TPU device metadata shared by the benchmarks.
+
+One table so every bench computes MFU against the same peak; a number
+corrected here propagates to bench.py, bench_vit.py and any future MFU
+report at once (they used to carry private copies that could drift).
+"""
+
+from __future__ import annotations
+
+# bf16 peak TFLOP/s per chip, keyed by a lowercase substring of
+# jax.Device.device_kind
+PEAK_TFLOPS = {
+    "tpu v5 lite": 197.0,
+    "tpu v5e": 197.0,
+    "tpu v4": 275.0,
+    "tpu v6 lite": 918.0,
+    "tpu v6e": 918.0,
+}
+
+_DEFAULT_PEAK = 197.0  # assume v5e-class when the kind string is unknown
+
+
+def peak_tflops(device) -> float:
+    """bf16 peak of ``device`` (a ``jax.Device``), by device_kind substring."""
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_TFLOPS.items():
+        if k in kind:
+            return v
+    return _DEFAULT_PEAK
+
+
+__all__ = ["PEAK_TFLOPS", "peak_tflops"]

@@ -202,3 +202,16 @@ def test_bench_vit_contract():
     stdout = _run("bench_vit.py", base="benchmarks")
     out = json.loads(stdout.strip().splitlines()[-1])
     assert out["unit"] == "images/sec/chip" and out["value"] > 0
+
+
+@pytest.mark.slow
+def test_imagenet_large_batch_recipe(tmp_path):
+    """--optimizer lars --warmup-epochs + --accum-steps through the stock
+    ImageNet script (the large-batch recipe knobs)."""
+    out = _run("imagenet/train_imagenet.py",
+               "--arch", "nin", "--epoch", "2", "--batchsize", "16",
+               "--train-size", "64", "--image-size", "64",
+               "--n-classes", "10", "--dtype", "float32",
+               "--optimizer", "lars", "--warmup-epochs", "1",
+               "--accum-steps", "2", "--out", str(tmp_path))
+    assert "loss" in out.lower() or "epoch" in out.lower()
