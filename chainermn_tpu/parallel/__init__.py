@@ -35,6 +35,13 @@ from chainermn_tpu.parallel.expert import (
     ExpertParallelMLP,
     moe_apply,
 )
+from chainermn_tpu.parallel.fsdp import (
+    FsdpMeta,
+    FsdpState,
+    fsdp_full_params,
+    fsdp_init,
+    make_fsdp_train_step,
+)
 
 __all__ = [
     "ColumnParallelDense",
@@ -43,8 +50,13 @@ __all__ = [
     "TensorParallelMLP",
     "moe_apply",
     "DATA_AXES",
+    "FsdpMeta",
+    "FsdpState",
     "INTER_AXIS",
     "INTRA_AXIS",
+    "fsdp_full_params",
+    "fsdp_init",
+    "make_fsdp_train_step",
     "Topology",
     "attention",
     "init_topology",

@@ -1,0 +1,217 @@
+"""ZeRO-3 / FSDP — fully-sharded data parallelism.
+
+**Beyond-reference extension** (the reference shards nothing: params,
+grads, and optimizer state are replicated per GPU — SURVEY.md §2.4; this
+module is labeled exactly like the other `parallel/` extensions).  It
+completes the ZeRO ladder the rebuild already climbs:
+`create_multi_node_optimizer(zero=True)` is stage 1 (optimizer state
+sharded, gradients reduce-scattered); here the PARAMETERS are sharded
+too — each device persistently stores 1/size of the flattened parameter
+space plus the inner optimizer state over that shard, and the full
+parameter set exists only transiently inside the train step.
+
+TPU-native design — the whole stage-3 communication pattern is ONE
+explicit collective plus its autodiff transpose:
+
+* forward: the step ``all_gather``\\ s the flat parameter shards over the
+  data axes and unpacks them into the model pytree (a device-varying,
+  transient full copy — exactly the memory the forward needs anyway);
+* backward: differentiating *with respect to the shards* makes JAX
+  transpose the all_gather into a ``reduce_scatter`` of the full
+  gradients — the ZeRO-2/3 gradient path falls out of the chain rule
+  instead of being hand-scheduled (the reference's NCCL world would need
+  explicit bucketed reduce-scatter calls);
+* update: the inner optax rule runs on the local shard only, so its
+  state (Adam m/v = 2x params) is divided by the world size, and the
+  updated shard feeds the next step's all_gather.
+
+Per-step wire cost is all_gather(params) + reduce_scatter(grads)
+≈ one ring allreduce of the parameter bytes, on the cheap ICI resource —
+the same total as plain DP's gradient allreduce — while persistent
+per-device memory drops from (params + grads + state) to
+(params + state)/size + transient full copies.
+
+Same caveat as ZeRO-1: the flat per-dtype shards erase leaf boundaries,
+so inner rules whose update depends on per-leaf structure (LARS/LAMB
+trust ratios) get shard-wise — i.e. wrong — semantics; use
+element-wise rules (sgd/momentum/adam/adamw/...).  BatchNorm state stays
+device-local and un-sharded (the reference's local-BN semantics,
+SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators import _packing
+
+
+def _reject_multi_node_wrapper(optimizer):
+    """FSDP takes a PLAIN optax rule: a multi-node wrapper's allreduce
+    inside the step would sum unrelated parameter shards across devices —
+    silent corruption, so refuse it loudly."""
+    from chainermn_tpu import optimizers as _opt
+
+    if isinstance(optimizer, (_opt._MultiNodeOptimizer,
+                              _opt._DoubleBufferingOptimizer,
+                              _opt._Zero1Optimizer)):
+        raise TypeError(
+            "fsdp takes a plain optax GradientTransformation, not a "
+            "create_multi_node_optimizer wrapper — the gather/scatter "
+            "collectives ARE the multi-node integration here")
+
+
+class FsdpMeta(NamedTuple):
+    """Static (host-side) layout of the sharded parameter space."""
+    pack_meta: Any          # _packing meta: (treedef, dtype keys, leaf order)
+    orig_lens: tuple        # unpadded flat length per dtype buffer
+    shard_lens: tuple       # per-device shard length per dtype buffer
+
+
+class FsdpState(NamedTuple):
+    """Per-device persistent state: stacked [size, shard] leaves, sharded
+    over the communicator's data axes (same layout convention as the
+    ZeRO-1 inner state and the double-buffer pending grads)."""
+    shards: Any             # list of [size, shard_len] param buffers
+    inner: Any              # inner optax state over the (squeezed) shards
+
+
+def fsdp_init(communicator, params, optimizer):
+    """Shard ``params`` for stage-3 training.
+
+    Returns ``(state, meta)``: ``state`` is the :class:`FsdpState` whose
+    leaves live sharded on the mesh; ``meta`` is the static layout that
+    :func:`make_fsdp_train_step` and :func:`fsdp_full_params` need.
+    ``optimizer`` is a plain optax rule (NOT a multi-node wrapper — the
+    collective pattern here IS the multi-node integration).
+    """
+    _reject_multi_node_wrapper(optimizer)
+    comm = communicator
+    size = comm.size
+    bufs, pack_meta = _packing.pack(params)
+    orig_lens, stacked = [], []
+    for b in bufs:
+        orig_lens.append(int(b.shape[0]))
+        b, _ = _packing.pad_to_multiple(b, size)
+        stacked.append(b.reshape(size, -1))
+    meta = FsdpMeta(pack_meta=pack_meta,
+                    orig_lens=tuple(orig_lens),
+                    shard_lens=tuple(int(s.shape[1]) for s in stacked))
+    # inner state over one device's shard shapes (identical zeros on every
+    # device at init, so broadcasting the stack is exact)
+    inner = optimizer.init([jnp.zeros((l,), s.dtype)
+                            for l, s in zip(meta.shard_lens, stacked)])
+    stacked_inner = jax.tree.map(
+        lambda z: jnp.broadcast_to(z, (size,) + z.shape), inner)
+    sharding = NamedSharding(comm.mesh, P(comm.data_axes))
+    return FsdpState(
+        shards=jax.device_put(stacked, sharding),
+        inner=jax.device_put(stacked_inner, sharding),
+    ), meta
+
+
+def fsdp_full_params(communicator, state: FsdpState, meta: FsdpMeta):
+    """Materialize the full (replicated) parameter pytree from the shards —
+    for evaluation, checkpointing, or export.  Outside the step the
+    stacked [size, shard] leaves ARE the full buffers, just reshaped."""
+    bufs = [s.reshape(-1)[:n] for s, n in zip(state.shards, meta.orig_lens)]
+    return _packing.unpack(bufs, meta.pack_meta)
+
+
+def make_fsdp_train_step(
+    communicator,
+    loss_fn: Callable,
+    optimizer,
+    meta: FsdpMeta,
+    has_aux: bool = False,
+    donate: bool = True,
+    with_model_state: bool = False,
+):
+    """Build the jitted stage-3 SPMD train step.
+
+    ``loss_fn(params, batch)`` (or ``loss_fn(params, model_state, batch)``
+    with ``with_model_state=True``) sees the full parameter pytree and the
+    local batch shard, exactly like :func:`make_train_step`'s — FSDP is a
+    storage/communication strategy, not a modeling change.  Returns
+    ``step(state, batch) -> (state, loss[, aux])`` (model-state variants
+    insert their slot like ``make_train_step``).  ``batch`` leaves are
+    sharded on their leading axis over the data axes; the loss reported is
+    the global mean.
+    """
+    _reject_multi_node_wrapper(optimizer)
+    comm = communicator
+    axes = comm.data_axes
+    axis_arg = axes if len(axes) > 1 else axes[0]
+    size = comm.size
+
+    def step(state, model_state, batch):
+        shards = [jnp.squeeze(s, 0) for s in state.shards]
+        inner = jax.tree.map(lambda a: jnp.squeeze(a, 0), state.inner)
+        if with_model_state:
+            model_state = jax.tree.map(
+                lambda a: jnp.squeeze(a, 0), model_state)
+
+        def local_loss(shards_, model_state_):
+            # all_gather over the data axes; its autodiff transpose IS the
+            # reduce-scatter of the full gradients (sum over devices)
+            full = [lax.all_gather(s, axis_arg, tiled=True)[:n]
+                    for s, n in zip(shards_, meta.orig_lens)]
+            params = _packing.unpack(full, meta.pack_meta)
+            if with_model_state:
+                return loss_fn(params, model_state_, batch)
+            return loss_fn(params, batch)
+
+        grad_fn = jax.value_and_grad(
+            local_loss, has_aux=has_aux or with_model_state)
+        if with_model_state:
+            (loss, packed), gshards = grad_fn(shards, model_state)
+            model_state, aux = packed if has_aux else (packed, None)
+        elif has_aux:
+            (loss, aux), gshards = grad_fn(shards, None)
+        else:
+            loss, gshards = grad_fn(shards, None)
+            aux = None
+        # transpose delivered the SUM over devices; reference
+        # allreduce_grad semantics are the mean
+        gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
+        updates, inner = optimizer.update(gshards, inner, shards)
+        shards = optax.apply_updates(shards, updates)
+
+        state = FsdpState(
+            shards=[s[None] for s in shards],
+            inner=jax.tree.map(lambda a: a[None], inner))
+        if with_model_state:
+            model_state = jax.tree.map(lambda a: a[None], model_state)
+        loss = comm.allreduce(loss, "mean")
+        if has_aux:
+            aux = comm.allreduce(aux, "mean")
+        outs = (state, model_state, loss, aux)
+        keep = (True, with_model_state, True, has_aux)
+        return tuple(o for o, k in zip(outs, keep) if k)
+
+    state_spec = FsdpState(shards=[P(axes)] * len(meta.shard_lens),
+                           inner=P(axes))
+    out_spec_all = (state_spec, P(axes), P(), P())
+    keep = (True, with_model_state, True, has_aux)
+    out_specs = tuple(s for s, k in zip(out_spec_all, keep) if k)
+    in_specs = ((state_spec, P(axes), P(axes)) if with_model_state
+                else (state_spec, P(axes)))
+    inner_fn = step
+    if not with_model_state:
+        def inner_fn(state, batch):  # noqa: F811
+            return step(state, None, batch)
+    mapped = jax.shard_map(inner_fn, mesh=comm.mesh,
+                           in_specs=in_specs, out_specs=out_specs)
+    donate_argnums = ((0, 1) if with_model_state else (0,)) if donate else ()
+    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
+__all__ = ["FsdpMeta", "FsdpState", "fsdp_init", "fsdp_full_params",
+           "make_fsdp_train_step"]
