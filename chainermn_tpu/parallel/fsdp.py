@@ -117,10 +117,12 @@ def fsdp_init(communicator, params, optimizer):
     ), meta
 
 
-def fsdp_full_params(communicator, state: FsdpState, meta: FsdpMeta):
+def fsdp_full_params(state: FsdpState, meta: FsdpMeta):
     """Materialize the full (replicated) parameter pytree from the shards —
-    for evaluation, checkpointing, or export.  Outside the step the
-    stacked [size, shard] leaves ARE the full buffers, just reshaped."""
+    for evaluation, checkpointing, or export.  No collective and no
+    communicator needed: outside the step the stacked [size, shard]
+    leaves ARE the full buffers, just reshaped (XLA resolves the
+    cross-device reads when the result is consumed)."""
     bufs = [s.reshape(-1)[:n] for s, n in zip(state.shards, meta.orig_lens)]
     return _packing.unpack(bufs, meta.pack_meta)
 

@@ -14,7 +14,10 @@ records held-out accuracy / BLEU against stated floors:
     BLEU-4 (floor 0.62; seed-0 measurement 0.6775, ~5 min on one core);
   * tiny-ResNet50 on the synthetic ImageNet path (32x32, 8 classes,
     2048 train / 256 val, lr 0.02, 3 epochs) -> validation accuracy
-    (floor 0.60; seed-0 CPU-mesh measurement 0.738, rising).
+    (floor 0.60; seed-0 CPU-mesh measurement 0.738, rising);
+  * tiny-ViT-S/16 on the same path (adam 1e-3, 3 epochs) -> validation
+    accuracy (floor 0.60; seed-0 CPU-mesh measurement 0.8164) — the
+    LayerNorm/attention bf16 surface, distinct from ResNet's BN/convs.
 
 BLEU reconciliation (round-4 judge weak #4): an early round-3 doc quoted
 "BLEU 0.82 offline" from a LONGER ad-hoc run; the pinned 30-epoch seed-0
@@ -54,6 +57,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MNIST_ACC_FLOOR = 0.97
 SEQ2SEQ_BLEU_FLOOR = 0.62
 RESNET_ACC_FLOOR = 0.60
+VIT_ACC_FLOOR = 0.60
 
 
 def _run_example(path, argv):
@@ -98,27 +102,45 @@ def check_seq2seq(seed=0):
             "floor": SEQ2SEQ_BLEU_FLOOR}
 
 
+def _check_imagenet(arch, extra_argv, floor, row, seed=0):
+    """Shared scaffold for the synthetic-ImageNet family rows: run the
+    stock example at 32px/8cls, parse the trainer's 'final:' line, gate
+    validation accuracy against ``floor``."""
+    out = _run_example(
+        os.path.join(REPO, "examples", "imagenet", "train_imagenet.py"),
+        ["--arch", arch, "--image-size", "32", "--n-classes", "8",
+         "--train-size", "2048", "--val-size", "256", "--batchsize", "16",
+         "--epoch", "3", "--communicator", "xla", "--seed", str(seed)]
+        + extra_argv)
+    m = re.search(r"final: (\{.*\})", out)
+    assert m, f"no final line in {arch} output:\n{out[-2000:]}"
+    final = json.loads(m.group(1).replace("'", '"'))
+    acc = float(final["validation/accuracy"])
+    assert acc >= floor, (
+        f"{arch} validation accuracy {acc} below floor {floor}")
+    return {"seed": seed, "epochs": 3, "communicator": "xla",
+            "val_accuracy": round(acc, 4), "floor": floor, **row}
+
+
 def check_tiny_resnet(seed=0):
     """ResNet-50 at toy shape on the synthetic ImageNet path — the
     bf16-everywhere numerics (BN stats psum, cast-allreduce-cast, bf16
     conv stack) are exactly where TPU convergence could silently differ
     from fp32 CPU, so this row is the one the on-chip ledger run is for."""
-    out = _run_example(
-        os.path.join(REPO, "examples", "imagenet", "train_imagenet.py"),
-        ["--arch", "resnet50", "--image-size", "32", "--n-classes", "8",
-         "--train-size", "2048", "--val-size", "256", "--batchsize", "16",
-         "--epoch", "3", "--communicator", "xla", "--lr", "0.02",
-         "--seed", str(seed)])
-    m = re.search(r"final: (\{.*\})", out)
-    assert m, f"no final line in imagenet output:\n{out[-2000:]}"
-    final = json.loads(m.group(1).replace("'", '"'))
-    acc = float(final["validation/accuracy"])
-    assert acc >= RESNET_ACC_FLOOR, (
-        f"tiny-ResNet validation accuracy {acc} below floor "
-        f"{RESNET_ACC_FLOOR}")
-    return {"seed": seed, "epochs": 3, "arch": "resnet50@32px/8cls",
-            "communicator": "xla", "lr": 0.02,
-            "val_accuracy": round(acc, 4), "floor": RESNET_ACC_FLOOR}
+    return _check_imagenet(
+        "resnet50", ["--lr", "0.02"], RESNET_ACC_FLOOR,
+        {"arch": "resnet50@32px/8cls", "lr": 0.02}, seed=seed)
+
+
+def check_tiny_vit(seed=0):
+    """ViT-S/16 on the same synthetic path (round-5 model family): the
+    LayerNorm/attention numerics in bf16 are a different failure surface
+    than ResNet's BN/conv stack, so the family gets its own pinned row
+    (seed-0 CPU-mesh measurement 0.8164; on-chip bf16 run reached 1.0)."""
+    return _check_imagenet(
+        "vit_s16", ["--optimizer", "adam", "--lr", "1e-3"], VIT_ACC_FLOOR,
+        {"arch": "vit_s16@32px/8cls", "optimizer": "adam", "lr": 1e-3},
+        seed=seed)
 
 
 def main():
@@ -137,7 +159,8 @@ def main():
            "checks": {}}
     checks = (("mnist_mlp", check_mnist),
               ("seq2seq_copy_reverse", check_seq2seq),
-              ("tiny_resnet_synthetic_imagenet", check_tiny_resnet))
+              ("tiny_resnet_synthetic_imagenet", check_tiny_resnet),
+              ("tiny_vit_synthetic_imagenet", check_tiny_vit))
     known = {n for n, _ in checks}
     selected = set(args.only.split(",")) if args.only else known
     unknown = selected - known

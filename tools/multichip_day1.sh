@@ -52,6 +52,10 @@ run 1 "$OUT/BENCH_$ROUND.json" \
     "headline ResNet-50 bench (driver-official format)" -- \
     bash -c "$PY_TPU bench.py > '$OUT/BENCH_$ROUND.json'"
 
+run 1 "$OUT/VIT_BENCH_$ROUND.json" \
+    "ViT-B/16 bench (the MXU compute-ceiling companion to the ResNet headline)" -- \
+    bash -c "$PY_TPU benchmarks/bench_vit.py > '$OUT/VIT_BENCH_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \
