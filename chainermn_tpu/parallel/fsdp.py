@@ -135,6 +135,7 @@ def make_fsdp_train_step(
     has_aux: bool = False,
     donate: bool = True,
     with_model_state: bool = False,
+    wire_dtype=None,
 ):
     """Build the jitted stage-3 SPMD train step.
 
@@ -146,12 +147,27 @@ def make_fsdp_train_step(
     insert their slot like ``make_train_step``).  ``batch`` leaves are
     sharded on their leading axis over the data axes; the loss reported is
     the global mean.
+
+    ``wire_dtype`` (e.g. ``"bfloat16"``) casts each float shard to the
+    wire dtype before the all_gather and back after — and because the
+    backward is the transpose of that chain, the gradient reduce-scatter
+    runs in the wire dtype too.  This is the fork's fp16-allreduce idea
+    (`allreduce_grad_dtype`) applied to stage 3's BOTH collectives:
+    half the gather bytes and half the scatter bytes, with the same
+    numerics tradeoff (the reduction accumulates in the wire dtype).
+    Master shards and the inner optimizer state stay full precision.
+    Non-float buffers (int params, if any) are never cast.
     """
     _reject_multi_node_wrapper(optimizer)
     comm = communicator
     axes = comm.data_axes
     axis_arg = axes if len(axes) > 1 else axes[0]
     size = comm.size
+    wire = jnp.dtype(wire_dtype) if wire_dtype is not None else None
+    if wire is not None and not jnp.issubdtype(wire, jnp.floating):
+        raise ValueError(
+            f"wire_dtype must be a floating dtype, got {wire} — an "
+            f"integer wire would truncate the gathered parameters")
 
     def step(state, model_state, batch):
         shards = [jnp.squeeze(s, 0) for s in state.shards]
@@ -162,9 +178,17 @@ def make_fsdp_train_step(
 
         def local_loss(shards_, model_state_):
             # all_gather over the data axes; its autodiff transpose IS the
-            # reduce-scatter of the full gradients (sum over devices)
-            full = [lax.all_gather(s, axis_arg, tiled=True)[:n]
-                    for s, n in zip(shards_, meta.orig_lens)]
+            # reduce-scatter of the full gradients (sum over devices).
+            # With wire_dtype the cast sits INSIDE the gather chain, so
+            # the transpose reduce-scatters in the wire dtype as well.
+            full = []
+            for s, n in zip(shards_, meta.orig_lens):
+                orig = s.dtype
+                if wire is not None and jnp.issubdtype(orig, jnp.floating) \
+                        and orig != wire:
+                    s = s.astype(wire)
+                g = lax.all_gather(s, axis_arg, tiled=True)[:n]
+                full.append(g.astype(orig))
             params = _packing.unpack(full, meta.pack_meta)
             if with_model_state:
                 return loss_fn(params, model_state_, batch)

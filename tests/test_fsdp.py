@@ -200,3 +200,51 @@ class TestCheckpoint:
         assert float(l2) == float(l3)
         for a, b in zip(jax.tree.leaves(s2), jax.tree.leaves(s3)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestWireDtype:
+    def test_bf16_wire_collectives(self, comm):
+        """wire_dtype='bfloat16' puts BOTH stage-3 collectives on a bf16
+        wire (the fork's fp16-allreduce idea), numerics within bf16
+        tolerance of the f32 wire."""
+        params, loss_fn, data = _mlp_problem(comm)
+        batch = put_global_batch(comm, data)
+
+        state_a, meta = fsdp_init(comm, params, optax.sgd(0.05))
+        step_a = make_fsdp_train_step(comm, loss_fn, optax.sgd(0.05), meta,
+                                      donate=False)
+        state_b, _ = fsdp_init(comm, params, optax.sgd(0.05))
+        step_b = make_fsdp_train_step(comm, loss_fn, optax.sgd(0.05), meta,
+                                      donate=False, wire_dtype="bfloat16")
+
+        # the LOWERED program hands XLA a bf16-wire gather and scatter
+        # (assert on StableHLO, not the compiled HLO: the CPU pipeline
+        # folds the casts back into f32 collectives — the same CPU-vs-TPU
+        # pass divergence docs/performance.md records for the
+        # double-buffer barrier; the TPU pipeline keeps bf16 wires, as
+        # the collective census pinned for the xla communicator's AR)
+        txt = jax.jit(step_b).lower(state_b, batch).as_text()
+        assert any("all_gather" in l and "xbf16>" in l
+                   for l in txt.splitlines())
+        import re
+        rs = re.search(r"reduce_scatter[^\n]*\n[^\n]*bf16", txt)
+        assert rs or any("reduce_scatter" in l and "xbf16>" in l
+                         for l in txt.splitlines())
+
+        for _ in range(3):
+            state_a, loss_a = step_a(state_a, batch)
+            state_b, loss_b = step_b(state_b, batch)
+        np.testing.assert_allclose(float(loss_b), float(loss_a),
+                                   rtol=3e-2)
+        full_a = fsdp_full_params(state_a, meta)
+        full_b = fsdp_full_params(state_b, meta)
+        for a, b in zip(jax.tree.leaves(full_a), jax.tree.leaves(full_b)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=5e-2, atol=5e-3)
+
+    def test_non_float_wire_rejected(self, comm):
+        params = {"w": jnp.zeros((4,))}
+        _, meta = fsdp_init(comm, params, optax.sgd(0.1))
+        with pytest.raises(ValueError, match="floating"):
+            make_fsdp_train_step(comm, lambda p, b: 0.0, optax.sgd(0.1),
+                                 meta, wire_dtype="int8")
