@@ -352,42 +352,10 @@ def make_train_step(
             return loss, aux, model_state, grads
 
         if accum_steps > 1:
-            b_local = jax.tree.leaves(batch)[0].shape[0]
-            if b_local % accum_steps:
-                raise ValueError(
-                    f"accum_steps ({accum_steps}) must divide the "
-                    f"per-device batch ({b_local})")
-            micro = jax.tree.map(
-                lambda a: a.reshape((accum_steps, b_local // accum_steps)
-                                    + a.shape[1:]), batch)
+            from chainermn_tpu.utils.accum import accumulate_microbatches
 
-            def body(carry, mb):
-                ms, g_acc, loss_acc, aux_acc = carry
-                loss, aux, ms, grads = compute(ms, mb)
-                g_acc = jax.tree.map(jnp.add, g_acc, grads)
-                aux_acc = (jax.tree.map(jnp.add, aux_acc, aux)
-                           if has_aux else aux_acc)
-                return (ms, g_acc, loss_acc + loss, aux_acc), None
-
-            # accumulators start as zeros shaped like one microbatch's
-            # grads/aux; eval_shape traces abstractly (no extra compile)
-            shapes = jax.eval_shape(
-                lambda: compute(model_state,
-                                jax.tree.map(lambda a: a[0], micro)))
-            # accumulators must carry the body outputs' varying axes
-            # (grads/loss of the pvaried params are device-varying)
-            zeros_varying = lambda t: jax.tree.map(
-                lambda s: pvary(jnp.zeros(s.shape, s.dtype), axes), t)
-            g0 = zeros_varying(shapes[3])
-            a0 = zeros_varying(shapes[1]) if has_aux else None
-            l0 = pvary(jnp.zeros((), jnp.float32), axes)
-            (model_state, grads, loss, aux), _ = jax.lax.scan(
-                body, (model_state, g0, l0, a0), micro)
-            k = jnp.float32(accum_steps)
-            grads = jax.tree.map(lambda g: g / k.astype(g.dtype), grads)
-            loss = loss / k
-            if has_aux:
-                aux = jax.tree.map(lambda a: a / k.astype(a.dtype), aux)
+            loss, aux, model_state, grads = accumulate_microbatches(
+                compute, model_state, batch, accum_steps, axes, has_aux)
         else:
             loss, aux, model_state, grads = compute(model_state, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)

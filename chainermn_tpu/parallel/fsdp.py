@@ -136,6 +136,7 @@ def make_fsdp_train_step(
     donate: bool = True,
     with_model_state: bool = False,
     wire_dtype=None,
+    accum_steps: int = 1,
 ):
     """Build the jitted stage-3 SPMD train step.
 
@@ -157,7 +158,21 @@ def make_fsdp_train_step(
     numerics tradeoff (the reduction accumulates in the wire dtype).
     Master shards and the inner optimizer state stay full precision.
     Non-float buffers (int params, if any) are never cast.
+
+    ``accum_steps=K`` — gradient accumulation with the same semantics as
+    :func:`chainermn_tpu.optimizers.make_train_step`'s: K equal
+    microbatches per device under ``lax.scan``, averaged gradients, one
+    update per optimizer step.  The gather/scatter pair runs per
+    MICROBATCH (each scan iteration re-gathers the params and
+    reduce-scatters its gradients — K× the collective bytes, the
+    standard FSDP-accumulation trade), but the gradient accumulator
+    lives at SHARD size and the transient full params are freed between
+    microbatches — exactly the memory posture stage 3 exists for.
+    Exact for batch-decomposable losses; BatchNorm models get
+    ghost-batch semantics (see make_train_step's docstring).
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     _reject_multi_node_wrapper(optimizer)
     comm = communicator
     axes = comm.data_axes
@@ -176,7 +191,7 @@ def make_fsdp_train_step(
             model_state = jax.tree.map(
                 lambda a: jnp.squeeze(a, 0), model_state)
 
-        def local_loss(shards_, model_state_):
+        def local_loss(shards_, model_state_, batch_):
             # all_gather over the data axes; its autodiff transpose IS the
             # reduce-scatter of the full gradients (sum over devices).
             # With wire_dtype the cast sits INSIDE the gather chain, so
@@ -191,19 +206,31 @@ def make_fsdp_train_step(
                 full.append(g.astype(orig))
             params = _packing.unpack(full, meta.pack_meta)
             if with_model_state:
-                return loss_fn(params, model_state_, batch)
-            return loss_fn(params, batch)
+                return loss_fn(params, model_state_, batch_)
+            return loss_fn(params, batch_)
 
         grad_fn = jax.value_and_grad(
             local_loss, has_aux=has_aux or with_model_state)
-        if with_model_state:
-            (loss, packed), gshards = grad_fn(shards, model_state)
-            model_state, aux = packed if has_aux else (packed, None)
-        elif has_aux:
-            (loss, aux), gshards = grad_fn(shards, None)
+
+        def compute(model_state_, batch_):
+            if with_model_state:
+                (loss, packed), gshards = grad_fn(shards, model_state_,
+                                                  batch_)
+                model_state_, aux = packed if has_aux else (packed, None)
+            elif has_aux:
+                (loss, aux), gshards = grad_fn(shards, None, batch_)
+            else:
+                loss, gshards = grad_fn(shards, None, batch_)
+                aux = None
+            return loss, aux, model_state_, gshards
+
+        if accum_steps > 1:
+            from chainermn_tpu.utils.accum import accumulate_microbatches
+
+            loss, aux, model_state, gshards = accumulate_microbatches(
+                compute, model_state, batch, accum_steps, axes, has_aux)
         else:
-            loss, gshards = grad_fn(shards, None)
-            aux = None
+            loss, aux, model_state, gshards = compute(model_state, batch)
         # transpose delivered the SUM over devices; reference
         # allreduce_grad semantics are the mean
         gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]

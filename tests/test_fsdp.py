@@ -248,3 +248,39 @@ class TestWireDtype:
         with pytest.raises(ValueError, match="floating"):
             make_fsdp_train_step(comm, lambda p, b: 0.0, optax.sgd(0.1),
                                  meta, wire_dtype="int8")
+
+
+class TestAccumSteps:
+    def test_accum_matches_full_batch(self, comm):
+        """accum_steps=4 reproduces the accum=1 trajectory exactly
+        (batch-decomposable loss), with shard-sized accumulators."""
+        params, loss_fn, data = _mlp_problem(comm)
+        batch = put_global_batch(comm, data)
+
+        state_a, meta = fsdp_init(comm, params, optax.adam(0.01))
+        step_a = make_fsdp_train_step(comm, loss_fn, optax.adam(0.01),
+                                      meta, donate=False)
+        state_b, _ = fsdp_init(comm, params, optax.adam(0.01))
+        step_b = make_fsdp_train_step(comm, loss_fn, optax.adam(0.01),
+                                      meta, donate=False, accum_steps=4)
+        for _ in range(3):
+            state_a, loss_a = step_a(state_a, batch)
+            state_b, loss_b = step_b(state_b, batch)
+        np.testing.assert_allclose(float(loss_b), float(loss_a), rtol=1e-6)
+        fa = fsdp_full_params(state_a, meta)
+        fb = fsdp_full_params(state_b, meta)
+        for a, b in zip(jax.tree.leaves(fa), jax.tree.leaves(fb)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_bad_accum_rejected(self, comm):
+        params, loss_fn, data = _mlp_problem(comm)
+        _, meta = fsdp_init(comm, params, optax.sgd(0.1))
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_fsdp_train_step(comm, loss_fn, optax.sgd(0.1), meta,
+                                 accum_steps=0)
+        step = make_fsdp_train_step(comm, loss_fn, optax.sgd(0.1), meta,
+                                    donate=False, accum_steps=3)
+        with pytest.raises(ValueError, match="divide"):
+            step(fsdp_init(comm, params, optax.sgd(0.1))[0],
+                 put_global_batch(comm, data))

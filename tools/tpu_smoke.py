@@ -336,9 +336,12 @@ def check_fsdp_vit_step():
             losses.append(float(loss))
         assert all(np.isfinite(l) for l in losses), (wire, losses)
         assert losses[-1] < losses[0], (wire, losses)
+        # params must have MOVED from init (a zero-update path would keep
+        # the loss check alive on dropout-free models but fail this)
         full = fsdp_full_params(state, meta)
-        delta = sum(float(jnp.abs(a).sum()) for a in jax.tree.leaves(full))
-        assert np.isfinite(delta) and delta > 0
+        delta = sum(float(jnp.abs(a - b).sum()) for a, b in
+                    zip(jax.tree.leaves(full), jax.tree.leaves(params)))
+        assert np.isfinite(delta) and delta > 0, delta
         rows["f32_wire" if wire is None else "bf16_wire"] = [
             round(l, 4) for l in losses]
     return {"losses": rows,
