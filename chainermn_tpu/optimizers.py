@@ -355,7 +355,7 @@ def make_train_step(
             from chainermn_tpu.utils.accum import accumulate_microbatches
 
             loss, aux, model_state, grads = accumulate_microbatches(
-                compute, model_state, batch, accum_steps, axes, has_aux)
+                compute, model_state, batch, accum_steps, has_aux)
         else:
             loss, aux, model_state, grads = compute(model_state, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)

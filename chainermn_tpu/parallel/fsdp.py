@@ -137,6 +137,9 @@ def make_fsdp_train_step(
     with_model_state: bool = False,
     wire_dtype=None,
     accum_steps: int = 1,
+    batch_spec=None,
+    global_loss: bool = False,
+    check_vma: bool = True,
 ):
     """Build the jitted stage-3 SPMD train step.
 
@@ -170,6 +173,29 @@ def make_fsdp_train_step(
     microbatches — exactly the memory posture stage 3 exists for.
     Exact for batch-decomposable losses; BatchNorm models get
     ghost-batch semantics (see make_train_step's docstring).
+
+    **Composing with sequence/context parallelism** (FSDP over the
+    sequence-parallel group — how long-context training ships: each
+    device computes its SEQUENCE shard with the full gathered params):
+
+    * ``batch_spec`` — PartitionSpec for the batch leaves (default
+      ``P(axes)``: data-parallel leading-axis sharding).  Pass e.g.
+      ``P(None, "sp")`` for sequence-sharded tokens.
+    * ``global_loss=True`` — declare that ``loss_fn`` already reduces
+      to the GLOBAL scalar itself (``lax.psum`` over the mesh axes, like
+      a sequence-parallel objective must).  The step then skips both its
+      /size gradient normalization (the transpose-summed shard grads ARE
+      the global gradient of a psum'd loss) and its final loss/aux
+      allreduce.  With the default ``False``, ``loss_fn`` returns the
+      LOCAL mean and the step applies reference ``allreduce_grad``
+      (mean) semantics.  With ``has_aux``, the aux leaves must be
+      globally reduced the same way — a device-local aux violates the
+      invariant out_spec and is rejected by the vma check at trace
+      time (do NOT disable ``check_vma`` while returning local aux:
+      that would silently report one device's value as global).
+    * ``check_vma`` — forwarded to ``shard_map`` (Pallas interpret mode
+      on the CPU backend trips a dynamic_slice vma check; TPU compiled
+      runs keep it True).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -228,12 +254,15 @@ def make_fsdp_train_step(
             from chainermn_tpu.utils.accum import accumulate_microbatches
 
             loss, aux, model_state, gshards = accumulate_microbatches(
-                compute, model_state, batch, accum_steps, axes, has_aux)
+                compute, model_state, batch, accum_steps, has_aux)
         else:
             loss, aux, model_state, gshards = compute(model_state, batch)
-        # transpose delivered the SUM over devices; reference
-        # allreduce_grad semantics are the mean
-        gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
+        if not global_loss:
+            # transpose delivered the SUM over devices; reference
+            # allreduce_grad semantics are the mean.  (With global_loss
+            # the loss was already psum-normalized inside loss_fn, so
+            # the summed shard grads ARE the global gradient.)
+            gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
         updates, inner = optimizer.update(gshards, inner, shards)
         shards = optax.apply_updates(shards, updates)
 
@@ -242,9 +271,10 @@ def make_fsdp_train_step(
             inner=jax.tree.map(lambda a: a[None], inner))
         if with_model_state:
             model_state = jax.tree.map(lambda a: a[None], model_state)
-        loss = comm.allreduce(loss, "mean")
-        if has_aux:
-            aux = comm.allreduce(aux, "mean")
+        if not global_loss:
+            loss = comm.allreduce(loss, "mean")
+            if has_aux:
+                aux = comm.allreduce(aux, "mean")
         outs = (state, model_state, loss, aux)
         keep = (True, with_model_state, True, has_aux)
         return tuple(o for o, k in zip(outs, keep) if k)
@@ -254,14 +284,16 @@ def make_fsdp_train_step(
     out_spec_all = (state_spec, P(axes), P(), P())
     keep = (True, with_model_state, True, has_aux)
     out_specs = tuple(s for s, k in zip(out_spec_all, keep) if k)
-    in_specs = ((state_spec, P(axes), P(axes)) if with_model_state
-                else (state_spec, P(axes)))
+    b_spec = P(axes) if batch_spec is None else batch_spec
+    in_specs = ((state_spec, P(axes), b_spec) if with_model_state
+                else (state_spec, b_spec))
     inner_fn = step
     if not with_model_state:
         def inner_fn(state, batch):  # noqa: F811
             return step(state, None, batch)
     mapped = jax.shard_map(inner_fn, mesh=comm.mesh,
-                           in_specs=in_specs, out_specs=out_specs)
+                           in_specs=in_specs, out_specs=out_specs,
+                           check_vma=check_vma)
     donate_argnums = ((0, 1) if with_model_state else (0,)) if donate else ()
     return jax.jit(mapped, donate_argnums=donate_argnums)
 

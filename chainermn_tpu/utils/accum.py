@@ -16,7 +16,7 @@ from chainermn_tpu.utils import pvary
 
 
 def accumulate_microbatches(compute, model_state, batch, accum_steps,
-                            axes, has_aux):
+                            has_aux):
     """Scan ``compute`` over K equal microbatches of the local shard.
 
     ``compute(model_state, microbatch) -> (loss, aux, model_state,
@@ -24,8 +24,10 @@ def accumulate_microbatches(compute, model_state, batch, accum_steps,
     any pytree (a param tree, a shard list, ...).  Returns the same
     4-tuple with loss/aux/grads AVERAGED over the K microbatches and the
     model state threaded through sequentially.  Must be called inside
-    the shard_map body: the accumulators are initialized varying over
-    ``axes`` to match the per-device loss/grads.
+    the shard_map body: the accumulators are typed (shape, dtype, AND
+    varying-axes) from an abstract trace of one microbatch, so both
+    device-varying local losses and psum-reduced invariant global losses
+    carry through the scan correctly.
     """
     b_local = jax.tree.leaves(batch)[0].shape[0]
     if b_local % accum_steps:
@@ -44,16 +46,25 @@ def accumulate_microbatches(compute, model_state, batch, accum_steps,
                    if has_aux else aux_acc)
         return (ms, g_acc, loss_acc + loss, aux_acc), None
 
-    # accumulators start as zeros shaped like one microbatch's grads/aux;
-    # eval_shape traces abstractly (no extra compile), and pvary gives
-    # them the varying axes the body outputs carry
+    # accumulators start as zeros shaped (and varying-axes-TYPED) like one
+    # microbatch's outputs; eval_shape traces abstractly (no extra
+    # compile) and its structs carry the exact vma the scan carry must
+    # match — a psum-reduced (invariant) loss stays invariant, per-device
+    # grads stay varying
     shapes = jax.eval_shape(
         lambda: compute(model_state, jax.tree.map(lambda a: a[0], micro)))
-    zeros_varying = lambda t: jax.tree.map(
-        lambda s: pvary(jnp.zeros(s.shape, s.dtype), axes), t)
-    g0 = zeros_varying(shapes[3])
-    a0 = zeros_varying(shapes[1]) if has_aux else None
-    l0 = pvary(jnp.zeros((), jnp.float32), axes)
+
+    def zeros_typed(s):
+        z = jnp.zeros(s.shape, s.dtype)
+        want = tuple(getattr(s, "vma", None) or ())
+        return pvary(z, want) if want else z
+
+    g0 = jax.tree.map(zeros_typed, shapes[3])
+    a0 = jax.tree.map(zeros_typed, shapes[1]) if has_aux else None
+    l0 = jnp.zeros((), jnp.float32)
+    loss_vma = tuple(getattr(shapes[0], "vma", None) or ())
+    if loss_vma:
+        l0 = pvary(l0, loss_vma)
     (model_state, grads, loss, aux), _ = jax.lax.scan(
         body, (model_state, g0, l0, a0), micro)
     k = jnp.float32(accum_steps)

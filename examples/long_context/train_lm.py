@@ -54,6 +54,13 @@ def main():
                    help="GQA/MQA: kv head count (must divide --heads; "
                         "flash/ring_flash read grouped kv natively)")
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--fsdp", action="store_true",
+                   help="shard params + optimizer state over the SAME "
+                        "sequence-parallel axis (ZeRO-3 over the sp "
+                        "group: gather params, compute the local "
+                        "sequence shard, reduce-scatter grads — "
+                        "parallel/fsdp.py); requires a sequence-parallel "
+                        "--attention")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -61,6 +68,11 @@ def main():
             args.kv_heads < 1 or args.heads % args.kv_heads):
         p.error(f"--kv-heads ({args.kv_heads}) must be >= 1 and divide "
                 f"--heads ({args.heads})")
+    if args.fsdp and args.attention not in ("ring", "ring_flash",
+                                            "ulysses"):
+        p.error("--fsdp composes with the sequence-parallel attentions "
+                "(ring/ring_flash/ulysses); single-shard runs have no "
+                "axis to shard over")
 
     devices = jax.devices()
     seq_parallel = args.attention in ("ring", "ring_flash", "ulysses")
@@ -84,35 +96,40 @@ def main():
                            seed=args.seed)
     params = ref_init.init(jax.random.key(args.seed), toks[:, :64])
     opt = optax.adam(args.lr)
-    opt_state = opt.init(params)
+    # replicated Adam state only without --fsdp (with it, the sharded
+    # state lives inside FsdpState — a full replica here would erase
+    # exactly the memory the flag sheds)
+    opt_state = None if args.fsdp else opt.init(params)
+
+    def sp_body(pp, tkk):
+        """Per-device objective on the LOCAL sequence shard — must run
+        inside an SPMD region over the 'sp' axis."""
+        me = jax.lax.axis_index("sp")
+        logits = model.apply(pp, tkk, pos_offset=me * t_local)
+        # global next-token objective: each shard also predicts the
+        # FIRST token of the next shard (fetched with one ppermute),
+        # so the loss matches the single-device xla/flash objective
+        # exactly (every position supervised except the global last)
+        nxt = jax.lax.ppermute(
+            tkk[:, :1], "sp",
+            perm=[(i, (i - 1) % n_sp) for i in range(n_sp)])
+        targets = jnp.concatenate([tkk[:, 1:], nxt], axis=1)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        mask = jnp.ones_like(ce)
+        mask = mask.at[:, -1].set(
+            jnp.where(me == n_sp - 1, 0.0, 1.0))
+        total = jax.lax.psum((ce * mask).sum(), "sp")
+        count = jax.lax.psum(mask.sum(), "sp")
+        return total / count
 
     if seq_parallel:
         def loss_fn(p_, tk):
-            def body(pp, tkk):
-                me = jax.lax.axis_index("sp")
-                logits = model.apply(pp, tkk, pos_offset=me * t_local)
-                # global next-token objective: each shard also predicts the
-                # FIRST token of the next shard (fetched with one ppermute),
-                # so the loss matches the single-device xla/flash objective
-                # exactly (every position supervised except the global last)
-                nxt = jax.lax.ppermute(
-                    tkk[:, :1], "sp",
-                    perm=[(i, (i - 1) % n_sp) for i in range(n_sp)])
-                targets = jnp.concatenate([tkk[:, 1:], nxt], axis=1)
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, targets)
-                mask = jnp.ones_like(ce)
-                mask = mask.at[:, -1].set(
-                    jnp.where(me == n_sp - 1, 0.0, 1.0))
-                total = jax.lax.psum((ce * mask).sum(), "sp")
-                count = jax.lax.psum(mask.sum(), "sp")
-                return total / count
-
             # check_vma=False: the Pallas interpret-mode interpreter (CPU
             # path of --attention ring_flash/flash) trips a dynamic_slice
             # vma check inside shard_map; on TPU the kernel is compiled and
             # no check is skipped.
-            return jax.shard_map(body, mesh=mesh,
+            return jax.shard_map(sp_body, mesh=mesh,
                                  in_specs=(P(), P(None, "sp")),
                                  out_specs=P(),
                                  check_vma=False)(p_, tk)
@@ -123,21 +140,44 @@ def main():
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tk[:, 1:]).mean()
 
-    @jax.jit
-    def step(p_, s_, tk):
-        l, g = jax.value_and_grad(loss_fn)(p_, tk)
-        updates, s_ = opt.update(g, s_, p_)
-        return optax.apply_updates(p_, updates), s_, l
-
     sync_each = jax.default_backend() == "cpu"
     print(f"attention={args.attention} devices={n_sp} "
           f"seq={args.seq_len} (local {t_local}) "
-          f"backend={jax.default_backend()}", flush=True)
+          f"fsdp={args.fsdp} backend={jax.default_backend()}", flush=True)
     t0 = time.time()
-    for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, toks)
-        if sync_each or i % 10 == 0 or i == args.steps - 1:
-            print(f"step {i}: loss {float(loss):.4f}", flush=True)
+    if args.fsdp:
+        # FSDP over the sequence-parallel group: params + Adam state live
+        # as 1/n_sp flat shards; the step gathers them, runs sp_body on
+        # the local sequence shard, and the gather's autodiff transpose
+        # reduce-scatters the gradients.  global_loss=True because
+        # sp_body already psums to the global objective.
+        import chainermn_tpu
+        from chainermn_tpu.parallel.fsdp import (
+            fsdp_full_params, fsdp_init, make_fsdp_train_step)
+
+        comm = chainermn_tpu.create_communicator("xla", mesh=mesh)
+        fsdp_state, meta = fsdp_init(comm, params, opt)
+        fsdp_step = make_fsdp_train_step(
+            comm, sp_body, opt, meta, batch_spec=P(None, "sp"),
+            global_loss=True, check_vma=False)
+        for i in range(args.steps):
+            fsdp_state, loss = fsdp_step(fsdp_state, toks)
+            if sync_each or i % 10 == 0 or i == args.steps - 1:
+                print(f"step {i}: loss {float(loss):.4f}", flush=True)
+        # anyone extending the example (checkpoint/eval) gets the
+        # TRAINED weights, not the init replica
+        params = fsdp_full_params(fsdp_state, meta)
+    else:
+        @jax.jit
+        def step(p_, s_, tk):
+            l, g = jax.value_and_grad(loss_fn)(p_, tk)
+            updates, s_ = opt.update(g, s_, p_)
+            return optax.apply_updates(p_, updates), s_, l
+
+        for i in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+            if sync_each or i % 10 == 0 or i == args.steps - 1:
+                print(f"step {i}: loss {float(loss):.4f}", flush=True)
     print(f"done in {time.time() - t0:.1f}s; "
           f"final loss {float(loss):.4f}", flush=True)
 

@@ -215,3 +215,20 @@ def test_imagenet_large_batch_recipe(tmp_path):
                "--optimizer", "lars", "--warmup-epochs", "1",
                "--accum-steps", "2", "--out", str(tmp_path))
     assert "loss" in out.lower() or "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_long_context_fsdp_matches_replicated():
+    """--fsdp (ZeRO-3 over the sequence-parallel axis) reproduces the
+    replicated run's loss trajectory exactly — same global objective,
+    params/Adam state stored as 1/n_sp shards."""
+    common = ["--attention", "ring", "--seq-len", "256", "--steps", "6",
+              "--batchsize", "2", "--d-model", "64", "--layers", "1"]
+    out_rep = _run("long_context/train_lm.py", *common)
+    out_fsdp = _run("long_context/train_lm.py", *common, "--fsdp")
+
+    def final(out):
+        import re
+        return float(re.search(r"final loss ([\d.]+)", out).group(1))
+
+    assert final(out_fsdp) == pytest.approx(final(out_rep), rel=1e-4)

@@ -284,3 +284,55 @@ class TestAccumSteps:
         with pytest.raises(ValueError, match="divide"):
             step(fsdp_init(comm, params, optax.sgd(0.1))[0],
                  put_global_batch(comm, data))
+
+
+class TestSequenceParallelComposition:
+    """batch_spec + global_loss: FSDP over a non-leading-axis-sharded
+    batch whose loss_fn psums to the global objective itself (the
+    FSDP x sequence-parallel composition; examples/long_context
+    --fsdp pins it end to end)."""
+
+    def test_global_loss_matches_replicated(self, comm):
+        from jax.sharding import PartitionSpec as P
+
+        # params [D]; batch [B, T] sharded over T; global objective =
+        # mean over ALL (b, t) of (w[t mod D] - x)^2 via psum
+        D = 6
+        params = {"w": jnp.arange(D, dtype=jnp.float32)}
+        rng = np.random.RandomState(0)
+        T = comm.size * 4
+        x = jnp.asarray(rng.randn(2, T).astype(np.float32))
+
+        axes = comm.data_axes
+
+        def loss_fn(p, batch):
+            (xb,) = batch   # [B, T/size] local sequence shard
+            me = comm.axis_index()
+            t_loc = xb.shape[1]
+            pos = me * t_loc + jnp.arange(t_loc)
+            w = p["w"][pos % D]
+            total = jax.lax.psum(((w[None, :] - xb) ** 2).sum(), axes)
+            count = jax.lax.psum(jnp.float32(xb.size), axes)
+            return total / count
+
+        state, meta = fsdp_init(comm, params, optax.sgd(0.1))
+        step = make_fsdp_train_step(
+            comm, loss_fn, optax.sgd(0.1), meta,
+            batch_spec=P(None, axes), global_loss=True, donate=False)
+
+        # replicated reference: same objective, plain jit
+        def ref_loss(p):
+            w = p["w"][jnp.arange(T) % D]
+            return jnp.mean((w[None, :] - x) ** 2)
+
+        p_ref = {"w": params["w"]}
+        for i in range(4):
+            state, loss = step(state, (x,))
+            l_ref, g_ref = jax.value_and_grad(ref_loss)(p_ref)
+            p_ref = jax.tree.map(lambda a, g: a - 0.1 * g, p_ref, g_ref)
+            np.testing.assert_allclose(float(loss), float(l_ref),
+                                       rtol=1e-6, err_msg=f"step {i}")
+        full = fsdp_full_params(state, meta)
+        np.testing.assert_allclose(np.asarray(full["w"]),
+                                   np.asarray(p_ref["w"]),
+                                   rtol=1e-6, atol=1e-7)
