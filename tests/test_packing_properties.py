@@ -1,0 +1,116 @@
+"""Property-style round-trip tests for the packing layer and flash block
+fitting — seeded random structures instead of hand-picked cases, because
+the edge cases that bite (scalar leaves, empty trees, mixed dtypes,
+awkward padding remainders, prime sequence lengths) are exactly the ones
+hand-written tests skip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.communicators import _packing
+
+
+def random_pytree(rng, n_leaves):
+    """A nested dict/list pytree of random-shaped, random-dtype leaves."""
+    dtypes = [jnp.float32, jnp.bfloat16, jnp.int32, jnp.float16]
+    leaves = []
+    for i in range(n_leaves):
+        ndim = rng.randint(0, 4)
+        shape = tuple(rng.randint(1, 5) for _ in range(ndim))
+        dt = dtypes[rng.randint(len(dtypes))]
+        if jnp.issubdtype(dt, jnp.integer):
+            a = jnp.asarray(rng.randint(-100, 100, size=shape), dt)
+        else:
+            a = jnp.asarray(rng.randn(*shape), dt)
+        leaves.append(a)
+    # build a nested structure: alternate dicts and lists
+    tree = {}
+    for i, leaf in enumerate(leaves):
+        bucket = tree.setdefault(f"g{i % 3}", [])
+        bucket.append(leaf)
+    return tree
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pack_unpack_round_trip(seed):
+    rng = np.random.RandomState(seed)
+    tree = random_pytree(rng, rng.randint(1, 12))
+    bufs, meta = _packing.pack(tree)
+    # buffers are flat and grouped by dtype
+    assert all(b.ndim == 1 for b in bufs)
+    out = _packing.unpack(bufs, meta)
+    assert jax.tree.structure(out) == jax.tree.structure(tree)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_empty_tree():
+    bufs, meta = _packing.pack({})
+    assert bufs == []
+    assert _packing.unpack(bufs, meta) == {}
+
+
+def test_pack_comm_dtype_single_buffer():
+    """comm_dtype packs EVERYTHING into one wire-dtype buffer."""
+    tree = {"a": jnp.ones((3,), jnp.float32),
+            "b": jnp.ones((2, 2), jnp.bfloat16)}
+    bufs, meta = _packing.pack(tree, comm_dtype=jnp.bfloat16)
+    assert len(bufs) == 1 and bufs[0].dtype == jnp.bfloat16
+    out = _packing.unpack(bufs, meta)
+    # original dtypes restored on unpack
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_pad_to_multiple_property(seed):
+    rng = np.random.RandomState(seed)
+    n = rng.randint(1, 100)
+    m = rng.randint(1, 12)
+    buf = jnp.asarray(rng.randn(n), jnp.float32)
+    padded, rem = _packing.pad_to_multiple(buf, m)
+    assert padded.shape[0] % m == 0
+    assert padded.shape[0] - n == rem < m
+    np.testing.assert_array_equal(np.asarray(padded[:n]), np.asarray(buf))
+    assert float(jnp.abs(padded[n:]).sum()) == 0.0
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fit_block_always_divides(seed):
+    """The default block auto-halves until it divides ANY T >= 1 (prime,
+    power of two, T < default, ...); explicit blocks are strict."""
+    from chainermn_tpu.ops.flash_attention import _fit_block
+
+    rng = np.random.RandomState(seed)
+    t = int(rng.randint(1, 5000))
+    b = _fit_block(t, None, 1024)
+    assert t % b == 0 and 1 <= b <= min(t, 1024)
+    # explicit non-divisor must raise, divisor must be honored
+    if t > 1:
+        bad = t - 1 if t % (t - 1) else 2 if t % 2 else 3
+        if t % bad:
+            with pytest.raises(ValueError):
+                _fit_block(t, bad, 1024)
+    assert _fit_block(t, t, 1024) == t
+
+
+def test_fsdp_init_scalar_and_mixed_dtype_params():
+    """fsdp_init handles scalar leaves and mixed dtypes (padding per
+    dtype buffer, exact round-trip through fsdp_full_params)."""
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.parallel.fsdp import fsdp_full_params, fsdp_init
+
+    comm = chainermn_tpu.create_communicator("flat")
+    params = {"s": jnp.asarray(3.25, jnp.float32),
+              "w": jnp.arange(13, dtype=jnp.float32),   # 14 % 8 != 0 pad
+              "h": jnp.ones((3, 5), jnp.bfloat16)}
+    state, meta = fsdp_init(comm, params, optax.sgd(0.1))
+    out = fsdp_full_params(state, meta)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(params)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
