@@ -232,3 +232,13 @@ def test_long_context_fsdp_matches_replicated():
         return float(re.search(r"final loss ([\d.]+)", out).group(1))
 
     assert final(out_fsdp) == pytest.approx(final(out_rep), rel=1e-4)
+
+
+@pytest.mark.slow
+def test_bench_lm_contract():
+    """bench_lm.py emits its one-JSON-line contract on any backend."""
+    import json
+
+    stdout = _run("bench_lm.py", base="benchmarks")
+    out = json.loads(stdout.strip().splitlines()[-1])
+    assert out["unit"] == "tokens/sec/chip" and out["value"] > 0

@@ -670,3 +670,63 @@ def test_seq2seq_example_two_controllers():
     assert "final:" in out1 and "val_bleu" in out1, out1
     # process 0 (encoder owner) trains but does not own the metrics
     assert "final:" not in results[0]["stdout"]
+
+
+_FSDP_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import chainermn_tpu
+chainermn_tpu.init_distributed(local_device_count=4)
+
+import flax.linen as nn
+import jax, jax.numpy as jnp, numpy as np, optax
+from chainermn_tpu.parallel.fsdp import (
+    fsdp_full_params, fsdp_init, make_fsdp_train_step)
+from chainermn_tpu.training import put_global_batch
+
+assert jax.process_count() == 2 and jax.device_count() == 8
+comm = chainermn_tpu.create_communicator("hierarchical")
+
+model = nn.Dense(4)
+xs = np.random.RandomState(0).randn(comm.size * 4, 8).astype(np.float32)
+ys = (xs @ np.random.RandomState(1).randn(8, 4)).astype(np.float32)
+params = model.init(jax.random.key(0), xs[:1])
+
+def loss_fn(p, b):
+    x, y = b
+    return jnp.mean((model.apply(p, x) - y) ** 2)
+
+state, meta = fsdp_init(comm, params, optax.adam(0.01))
+step = make_fsdp_train_step(comm, loss_fn, optax.adam(0.01), meta,
+                            donate=False)
+batch = put_global_batch(comm, (xs, ys))
+losses = []
+for _ in range(4):
+    state, loss = step(state, batch)
+    losses.append(float(loss))
+# every shard leaf lives sharded across BOTH processes' devices
+n_shards = sum(len(s.sharding.device_set) for s in state.shards)
+w_sum = float(sum(jnp.abs(a).sum()
+                  for a in jax.tree.leaves(fsdp_full_params(state, meta))))
+print("RESULT " + json.dumps({
+    "losses": losses, "rank": comm.host_rank,
+    "devices_per_shard": n_shards / len(state.shards),
+    "w_sum": w_sum}))
+"""
+
+
+@pytest.mark.slow
+def test_two_controller_fsdp_training():
+    """ZeRO-3/FSDP across two REAL controller processes: param shards
+    span both hosts' devices (8-way), losses decrease and match on both
+    controllers, and the materialized full params agree."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = spawn_world(_FSDP_WORKER, n_procs=2, local_devices=4,
+                          timeout=300, repo=repo)
+    assert results[0]["losses"] == pytest.approx(results[1]["losses"],
+                                                 rel=1e-6)
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
+    for r in (0, 1):
+        assert results[r]["devices_per_shard"] == 8
+    assert results[0]["w_sum"] == pytest.approx(results[1]["w_sum"],
+                                                rel=1e-6)

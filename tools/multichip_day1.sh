@@ -56,6 +56,10 @@ run 1 "$OUT/VIT_BENCH_$ROUND.json" \
     "ViT-B/16 bench (the MXU compute-ceiling companion to the ResNet headline)" -- \
     bash -c "$PY_TPU benchmarks/bench_vit.py > '$OUT/VIT_BENCH_$ROUND.json'"
 
+run 1 "$OUT/LM_BENCH_$ROUND.json" \
+    "Transformer-LM bench (554M params, T=8192, flash kernels - the 52% MFU panel)" -- \
+    bash -c "$PY_TPU benchmarks/bench_lm.py > '$OUT/LM_BENCH_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \
