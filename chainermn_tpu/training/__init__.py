@@ -1,4 +1,6 @@
 from chainermn_tpu.training.trainer import (
+    FsdpStatefulUpdater,
+    FsdpUpdater,
     StandardUpdater,
     StatefulUpdater,
     Trainer,
@@ -6,5 +8,5 @@ from chainermn_tpu.training.trainer import (
 )
 from chainermn_tpu.training import extensions
 
-__all__ = ["StandardUpdater", "StatefulUpdater", "Trainer", "extensions",
-           "put_global_batch"]
+__all__ = ["FsdpStatefulUpdater", "FsdpUpdater", "StandardUpdater",
+           "StatefulUpdater", "Trainer", "extensions", "put_global_batch"]

@@ -140,6 +140,78 @@ class StatefulUpdater(StandardUpdater):
         return obs
 
 
+class FsdpUpdater(StandardUpdater):
+    """Updater over a ZeRO-3/FSDP train step (beyond-reference extension,
+    `chainermn_tpu.parallel.fsdp`).
+
+    ``step_fn(fsdp_state, batch) -> (fsdp_state, loss[, aux])`` — from
+    :func:`make_fsdp_train_step`.  The :class:`FsdpState` (sharded param
+    + inner-optimizer buffers) rides the ``opt_state`` slot, and
+    ``.params`` becomes a PROPERTY that materializes the full parameter
+    pytree on demand (``fsdp_full_params``) — so evaluators and
+    checkpoint-state builders written against ``updater.params`` keep
+    working unchanged.  For checkpointing prefer saving ``opt_state``
+    (the FsdpState round-trips through the multi-node checkpointer with
+    mesh placement preserved — tests/test_fsdp.py); a saved ``.params``
+    snapshot is a derived full copy.
+    """
+
+    def __init__(self, iterator, step_fn: Callable, fsdp_state, meta, comm,
+                 convert_batch: Optional[Callable] = None):
+        self._meta = meta
+        super().__init__(iterator, step_fn, None, fsdp_state, comm,
+                         convert_batch)
+
+    @property
+    def params(self):
+        from chainermn_tpu.parallel.fsdp import fsdp_full_params
+
+        return fsdp_full_params(self.opt_state, self._meta)
+
+    @params.setter
+    def params(self, value):
+        # the base __init__ assigns the placeholder; params are DERIVED
+        # from the sharded state here, so anything else is a usage error
+        if value is not None:
+            raise AttributeError(
+                "FsdpUpdater.params is derived from the sharded FsdpState "
+                "(opt_state); assign a new opt_state instead")
+
+    def update(self) -> dict:
+        batch = self._put(self.iterator.next())
+        out = self.step_fn(self.opt_state, batch)
+        self.opt_state = out[0]
+        self.iteration += 1
+        obs = {"main/loss": out[1]}
+        if len(out) > 2 and out[2] is not None:
+            obs.update({f"main/{k}": v for k, v in out[2].items()})
+        return obs
+
+
+class FsdpStatefulUpdater(FsdpUpdater):
+    """FsdpUpdater + device-local mutable model state (local-BN
+    semantics): ``step_fn(fsdp_state, model_state, batch) ->
+    (fsdp_state, model_state, loss[, aux])`` — from
+    ``make_fsdp_train_step(..., with_model_state=True)``."""
+
+    def __init__(self, iterator, step_fn: Callable, fsdp_state, meta,
+                 model_state, comm,
+                 convert_batch: Optional[Callable] = None):
+        super().__init__(iterator, step_fn, fsdp_state, meta, comm,
+                         convert_batch)
+        self.model_state = model_state
+
+    def update(self) -> dict:
+        batch = self._put(self.iterator.next())
+        out = self.step_fn(self.opt_state, self.model_state, batch)
+        self.opt_state, self.model_state = out[0], out[1]
+        self.iteration += 1
+        obs = {"main/loss": out[2]}
+        if len(out) > 3 and out[3] is not None:
+            obs.update({f"main/{k}": v for k, v in out[3].items()})
+        return obs
+
+
 class Trainer:
     """Trigger-driven training loop (the Chainer ``Trainer`` role)."""
 

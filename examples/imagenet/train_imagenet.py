@@ -39,7 +39,8 @@ from chainermn_tpu.models import (
 from chainermn_tpu.optimizers import (
     init_model_state, init_opt_state, make_train_step)
 from chainermn_tpu.training import (
-    StandardUpdater, StatefulUpdater, Trainer, extensions)
+    FsdpStatefulUpdater, FsdpUpdater, StandardUpdater, StatefulUpdater,
+    Trainer, extensions)
 
 ARCHS = {
     "alex": (AlexNet, False),
@@ -76,6 +77,11 @@ def main():
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1 optimizer-state sharding (extension; "
                              "exclusive with --double-buffering)")
+    parser.add_argument("--fsdp", action="store_true",
+                        help="ZeRO-3/FSDP: params AND optimizer state "
+                             "sharded per device, gathered inside the "
+                             "step (extension, parallel/fsdp.py; "
+                             "exclusive with --zero/--double-buffering)")
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--n-classes", type=int, default=1000)
     parser.add_argument("--train-size", type=int, default=4096,
@@ -139,10 +145,13 @@ def main():
     args = parser.parse_args()
     if args.zero and args.double_buffering:
         parser.error("--zero and --double-buffering are mutually exclusive")
-    if args.zero and args.optimizer == "lars":
-        parser.error("--zero flattens parameters into per-device shards, "
-                     "which destroys LARS's per-layer trust ratios — use "
-                     "--optimizer momentum/adam with --zero")
+    if args.fsdp and (args.zero or args.double_buffering):
+        parser.error("--fsdp already shards params+grads+state; --zero "
+                     "and --double-buffering do not compose with it")
+    if (args.zero or args.fsdp) and args.optimizer == "lars":
+        parser.error("--zero/--fsdp flatten parameters into per-device "
+                     "shards, which destroys LARS's per-layer trust "
+                     "ratios — use --optimizer momentum/adam")
     if args.batchsize % args.accum_steps:
         parser.error("--accum-steps must divide --batchsize")
 
@@ -263,10 +272,17 @@ def main():
         "lars": lambda: optax.lars(lr, momentum=0.9),
         "adam": lambda: optax.adam(lr),
     }[args.optimizer]()
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        base_optimizer, comm,
-        double_buffering=args.double_buffering, zero=args.zero)
-    opt_state = init_opt_state(comm, optimizer, params)
+    if args.fsdp:
+        # ZeRO-3: the gather/scatter collectives ARE the multi-node
+        # integration — no wrapper; opt_state carries the FsdpState
+        from chainermn_tpu.parallel.fsdp import fsdp_init
+
+        opt_state, fsdp_meta = fsdp_init(comm, params, base_optimizer)
+    else:
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            base_optimizer, comm,
+            double_buffering=args.double_buffering, zero=args.zero)
+        opt_state = init_opt_state(comm, optimizer, params)
 
     model_state = (init_model_state(comm, variables["batch_stats"])
                    if has_bn else None)
@@ -281,9 +297,13 @@ def main():
             keep=args.checkpoint_keep)
 
         def make_ckpt_state(params, model_state, opt_state, iteration):
-            s = {"params": params, "opt_state": opt_state,
+            # with --fsdp the FsdpState (opt_state slot) IS the params;
+            # a separate full-params snapshot would be a redundant copy
+            s = {"opt_state": opt_state,
                  "iteration": np.int64(iteration),
                  "iterator": base_iter.state_dict()}
+            if not args.fsdp:
+                s["params"] = params
             if has_bn:
                 s["model_state"] = model_state
             return s
@@ -291,7 +311,9 @@ def main():
         restored, gen = ckpt.resume(
             make_ckpt_state(params, model_state, opt_state, 0))
         if gen is not None:
-            params, opt_state = restored["params"], restored["opt_state"]
+            opt_state = restored["opt_state"]
+            if not args.fsdp:
+                params = restored["params"]
             if has_bn:
                 model_state = restored["model_state"]
             base_iter.load_state_dict(restored["iterator"])
@@ -324,11 +346,21 @@ def main():
             acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
             return loss, (mutated["batch_stats"], {"accuracy": acc})
 
-        step = make_train_step(comm, loss_fn, optimizer, has_aux=True,
-                               with_model_state=True,
-                               accum_steps=args.accum_steps)
-        updater = StatefulUpdater(train_iter, step, params, model_state,
-                                  opt_state, comm, convert_batch=convert)
+        if args.fsdp:
+            from chainermn_tpu.parallel.fsdp import make_fsdp_train_step
+
+            step = make_fsdp_train_step(
+                comm, loss_fn, base_optimizer, fsdp_meta, has_aux=True,
+                with_model_state=True, accum_steps=args.accum_steps)
+            updater = FsdpStatefulUpdater(train_iter, step, opt_state,
+                                          fsdp_meta, model_state, comm,
+                                          convert_batch=convert)
+        else:
+            step = make_train_step(comm, loss_fn, optimizer, has_aux=True,
+                                   with_model_state=True,
+                                   accum_steps=args.accum_steps)
+            updater = StatefulUpdater(train_iter, step, params, model_state,
+                                      opt_state, comm, convert_batch=convert)
     else:
         def loss_fn(p, batch):
             x, y, it = batch
@@ -344,10 +376,19 @@ def main():
             acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
             return loss, {"accuracy": acc}
 
-        step = make_train_step(comm, loss_fn, optimizer, has_aux=True,
-                               accum_steps=args.accum_steps)
-        updater = StandardUpdater(train_iter, step, params, opt_state, comm,
-                                  convert_batch=convert)
+        if args.fsdp:
+            from chainermn_tpu.parallel.fsdp import make_fsdp_train_step
+
+            step = make_fsdp_train_step(
+                comm, loss_fn, base_optimizer, fsdp_meta, has_aux=True,
+                accum_steps=args.accum_steps)
+            updater = FsdpUpdater(train_iter, step, opt_state, fsdp_meta,
+                                  comm, convert_batch=convert)
+        else:
+            step = make_train_step(comm, loss_fn, optimizer, has_aux=True,
+                                   accum_steps=args.accum_steps)
+            updater = StandardUpdater(train_iter, step, params, opt_state,
+                                      comm, convert_batch=convert)
 
     updater.iteration = start_iteration
     trainer = Trainer(updater, (args.epoch, "epoch"), out=args.out)
@@ -355,7 +396,9 @@ def main():
         trainer.extend(extensions.Snapshot(
             ckpt,
             lambda t: make_ckpt_state(
-                t.updater.params,
+                # --fsdp: don't materialize the full-params copy the
+                # ckpt dict would discard (the FsdpState IS the params)
+                None if args.fsdp else t.updater.params,
                 getattr(t.updater, "model_state", None),
                 t.updater.opt_state, t.updater.iteration),
             trigger=((args.checkpoint_freq, "iteration")

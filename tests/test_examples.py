@@ -242,3 +242,51 @@ def test_bench_lm_contract():
     stdout = _run("bench_lm.py", base="benchmarks")
     out = json.loads(stdout.strip().splitlines()[-1])
     assert out["unit"] == "tokens/sec/chip" and out["value"] > 0
+
+
+@pytest.mark.slow
+def test_imagenet_fsdp_matches_plain_dp(tmp_path):
+    """--fsdp (ZeRO-3 through the stock Trainer stack, FsdpUpdater)
+    reproduces the plain-DP run: same seed, same final metrics."""
+    common = ["--arch", "vit_s16", "--epoch", "2", "--batchsize", "8",
+              "--train-size", "64", "--image-size", "32",
+              "--n-classes", "8", "--dtype", "float32", "--seed", "5"]
+    out_a = _run("imagenet/train_imagenet.py", *common,
+                 "--out", str(tmp_path / "a"))
+    out_b = _run("imagenet/train_imagenet.py", *common, "--fsdp",
+                 "--out", str(tmp_path / "b"))
+
+    import re
+
+    def final_val_loss(out):
+        return float(re.search(r"'validation/loss': ([\d.e+-]+)",
+                               out).group(1))
+
+    assert final_val_loss(out_b) == pytest.approx(final_val_loss(out_a),
+                                                  rel=1e-4)
+
+
+@pytest.mark.slow
+def test_imagenet_fsdp_checkpoint_resume(tmp_path):
+    """--fsdp + --checkpoint: the FsdpState snapshots and auto-resumes
+    (interrupted run lands on the uninterrupted run's final metrics)."""
+    common = ["--arch", "vit_s16", "--batchsize", "8", "--train-size",
+              "64", "--image-size", "32", "--n-classes", "8", "--dtype",
+              "float32", "--prefetch", "0", "--seed", "7", "--fsdp"]
+
+    def last_val_loss(out):
+        rows = [l.split() for l in out.splitlines()
+                if l.strip() and l.split()[0].isdigit()]
+        assert rows, out
+        return float(rows[-1][4])
+
+    out_a = _run("imagenet/train_imagenet.py", *common, "--epoch", "2",
+                 "--out", str(tmp_path / "a"))
+    ck = str(tmp_path / "ck")
+    _run("imagenet/train_imagenet.py", *common, "--epoch", "1",
+         "--checkpoint", ck, "--out", str(tmp_path / "b"))
+    out_b2 = _run("imagenet/train_imagenet.py", *common, "--epoch", "2",
+                  "--checkpoint", ck, "--out", str(tmp_path / "b"))
+    assert "resumed from snapshot" in out_b2
+    assert last_val_loss(out_b2) == pytest.approx(last_val_loss(out_a),
+                                                  rel=1e-5)
