@@ -203,10 +203,20 @@ def main():
                         help="train steps fused per dispatch via lax.scan "
                              "(default 1; >1 measured SLOWER on this model "
                              "- scan-body layout assignment)")
+    parser.add_argument("--metrics", default=None, metavar="PATH",
+                        help="append the result record to this metrics "
+                             "JSONL (shared observability schema; render "
+                             "with tools/obs_report.py)")
     args = parser.parse_args()
 
     out = retry_transient(lambda: run(args), attempts=args.attempts,
                           label="bench")
+    if args.metrics:
+        import time as _time
+
+        from chainermn_tpu.observability import append_jsonl
+
+        append_jsonl(args.metrics, dict(out, kind="bench", ts=_time.time()))
     print(json.dumps(out), flush=True)
 
 

@@ -39,6 +39,10 @@ def main():
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line per flavor")
+    parser.add_argument("--metrics", default=None, metavar="PATH",
+                        help="append one record per flavor to this metrics "
+                             "JSONL (shared observability schema; render "
+                             "with tools/obs_report.py)")
     parser.add_argument("--scaling", action="store_true",
                         help="sweep device counts (2, 4, ..., all) per "
                              "flavor and report scaling efficiency vs the "
@@ -157,6 +161,11 @@ def main():
             row["efficiency_vs"] = bn
             row["scaling_efficiency"] = round(busbw / bb, 3) if bb else None
         results.append(row)
+        if args.metrics:
+            from chainermn_tpu.observability import append_jsonl
+
+            append_jsonl(args.metrics,
+                         dict(row, kind="bench_allreduce", ts=time.time()))
         if args.json:
             print(json.dumps(row), flush=True)
         else:

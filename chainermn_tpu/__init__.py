@@ -28,6 +28,8 @@ _EXPORTS = {
     "NpzImageDataset": "chainermn_tpu.datasets",
     "PrefetchIterator": "chainermn_tpu.datasets",
     "normalize_image": "chainermn_tpu.datasets",
+    # runtime observability (beyond-reference subsystem)
+    "instrument_communicator": "chainermn_tpu.observability",
     "create_multi_node_evaluator": "chainermn_tpu.extensions",
     "AllreducePersistent": "chainermn_tpu.extensions",
     "create_multi_node_checkpointer": "chainermn_tpu.extensions",

@@ -23,6 +23,8 @@ from collections import OrderedDict
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
+
+from chainermn_tpu.utils import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -262,7 +264,7 @@ class MeshCommunicator(CommunicatorBase):
             out = f(*squeezed)
             return jax.tree.map(lambda a: jnp.expand_dims(a, 0), out)
 
-        fn = jax.shard_map(per_rank, mesh=self._mesh,
+        fn = _shard_map(per_rank, mesh=self._mesh,
                            in_specs=spec, out_specs=spec)
         if jit:
             fn = jax.jit(fn)

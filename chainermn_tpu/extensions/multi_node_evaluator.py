@@ -22,6 +22,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from chainermn_tpu.utils import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -47,7 +49,7 @@ def make_eval_fn(communicator, metrics_fn: Callable,
             m = metrics_fn(params, state, batch)
             return comm.allreduce(m, "mean")
 
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             eval_step, mesh=comm.mesh,
             in_specs=(P(), P(comm.data_axes), P(comm.data_axes)),
             out_specs=P())
@@ -57,7 +59,7 @@ def make_eval_fn(communicator, metrics_fn: Callable,
         m = metrics_fn(params, batch)
         return comm.allreduce(m, "mean")
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         eval_step, mesh=comm.mesh,
         in_specs=(P(), P(comm.data_axes)), out_specs=P())
     return jax.jit(mapped)

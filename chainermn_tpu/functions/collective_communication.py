@@ -17,6 +17,8 @@ where each device is one reference rank.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -52,7 +54,31 @@ def scatter(communicator, x, root: int = 0):
     return communicator.scatter(x, root=root)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 2))
+def _allreduce_diff(communicator, x, op):
+    return communicator.allreduce(x, op=op)
+
+
+def _allreduce_fwd(communicator, x, op):
+    return communicator.allreduce(x, op=op), None
+
+
+def _allreduce_bwd(communicator, op, _res, g):
+    # The cotangent of an allreduce output is replicated across ranks, so
+    # the transpose is the identity (scaled by 1/size for the mean).  Pinned
+    # explicitly because jax versions without replication tracking would
+    # otherwise transpose psum to psum, inflating the gradient by ``size``.
+    if op == "mean":
+        g = jax.tree.map(lambda v: v / communicator.size, g)
+    return (g,)
+
+
+_allreduce_diff.defvjp(_allreduce_fwd, _allreduce_bwd)
+
+
 def allreduce(communicator, x, op: str = "sum"):
     """Allreduce with differentiable semantics (psum's transpose is the
     identity broadcast of the cotangent to every rank)."""
+    if op in ("sum", "mean"):
+        return _allreduce_diff(communicator, x, op)
     return communicator.allreduce(x, op=op)

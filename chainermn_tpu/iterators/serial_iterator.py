@@ -32,6 +32,17 @@ class SerialIterator:
         # from the trainer thread — without this the snapshot could tear
         # (pos from before a reshuffle, order/rng from after).
         self._state_lock = threading.Lock()
+        # Observability seam, bound once at construction: None when the
+        # switch is off, so __next__ does one attribute check and nothing
+        # else.  Latency lands in iterator_next_seconds (decode/collate
+        # time; masked time when a PrefetchIterator sits in front).
+        self._obs_timer = None
+        from chainermn_tpu.observability import enabled, get_registry
+        if enabled():
+            self._obs_timer = get_registry().timer(
+                "iterator_next_seconds",
+                "host time per SerialIterator batch draw",
+                iterator=type(self).__name__)
 
     def _new_order(self):
         n = len(self.dataset)
@@ -48,6 +59,12 @@ class SerialIterator:
         return self
 
     def __next__(self):
+        if self._obs_timer is not None:
+            with self._obs_timer:
+                return self._draw()
+        return self._draw()
+
+    def _draw(self):
         with self._state_lock:
             n = len(self.dataset)
             if self._pos >= n:

@@ -1,0 +1,179 @@
+"""Cross-rank straggler telemetry.
+
+Every controller keeps a rolling window of its step wall times; on demand
+the per-rank summaries are allgathered over the communicator's control
+plane (DCN — the multi-controller "heartbeat" path; in single-controller
+mode the world is one summary) and ranks whose mean step time exceeds
+``threshold x median`` are flagged.  This is the always-on signal the
+paper-scale runs need: a slow host (thermal throttle, noisy neighbor,
+failing NIC) drags EVERY rank's step time under synchronous data
+parallelism, and only a per-rank view says which one.
+
+All participants must call :meth:`StragglerDetector.report` at the same
+cadence (it is a collective over the control plane) — the
+``MetricsReport`` extension drives it from iteration/epoch triggers,
+which fire identically on every rank.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import time
+from typing import List, Optional
+
+
+def summarize_durations(durations) -> dict:
+    """Order statistics of a duration window: count/mean/p50/p95/max (and
+    total) in seconds.  Pure function — the unit the cross-rank report
+    aggregates."""
+    ds = sorted(float(d) for d in durations)
+    if not ds:
+        return {"count": 0, "total_s": 0.0, "mean_s": None, "p50_s": None,
+                "p95_s": None, "max_s": None}
+
+    def q(p):
+        pos = p * (len(ds) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(ds) - 1)
+        return ds[lo] + (ds[hi] - ds[lo]) * (pos - lo)
+
+    return {
+        "count": len(ds),
+        "total_s": sum(ds),
+        "mean_s": sum(ds) / len(ds),
+        "p50_s": q(0.5),
+        "p95_s": q(0.95),
+        "max_s": ds[-1],
+    }
+
+
+def straggler_report(summaries: List[dict], threshold: float = 1.5) -> dict:
+    """Flag ranks whose mean step time exceeds ``threshold x median`` of
+    the per-rank means.  ``summaries``: one :func:`summarize_durations`
+    dict per rank, each carrying a ``rank`` key.  Pure function, so the
+    aggregation is testable without a multi-host world."""
+    if threshold <= 1.0:
+        raise ValueError(f"threshold must be > 1 (got {threshold}): at 1.0 "
+                         "every above-median rank would be a 'straggler'")
+    means = [s["mean_s"] for s in summaries if s.get("mean_s") is not None]
+    median = statistics.median(means) if means else None
+    stragglers = []
+    if median and median > 0:
+        for s in summaries:
+            if s.get("mean_s") is not None and s["mean_s"] > threshold * median:
+                stragglers.append({
+                    "rank": s.get("rank"),
+                    "mean_s": s["mean_s"],
+                    "ratio_vs_median": s["mean_s"] / median,
+                })
+    return {
+        "kind": "straggler_report",
+        "n_ranks": len(summaries),
+        "median_step_s": median,
+        "threshold": threshold,
+        "ranks": summaries,
+        "stragglers": stragglers,
+    }
+
+
+class StragglerDetector:
+    """Rolling per-rank step-time window + the cross-rank collective report.
+
+    ``comm=None`` (or a single-host world) degrades to a local-only
+    report — same schema, one rank.
+    """
+
+    def __init__(self, comm=None, threshold: float = 1.5,
+                 window_size: int = 512):
+        if threshold <= 1.0:
+            raise ValueError(f"threshold must be > 1, got {threshold}")
+        self._comm = comm
+        self.threshold = float(threshold)
+        self._durations = collections.deque(maxlen=int(window_size))
+
+    def record(self, seconds: float) -> None:
+        self._durations.append(float(seconds))
+
+    def local_summary(self) -> dict:
+        s = summarize_durations(self._durations)
+        s["rank"] = self._comm.rank if self._comm is not None else 0
+        s["ts"] = time.time()
+        return s
+
+    def report(self, reset: bool = False) -> dict:
+        """Allgather per-rank summaries and flag stragglers.
+
+        COLLECTIVE over the control plane when the world has more than
+        one controller: every rank must call it at the same point (drive
+        it from a trainer trigger, which fires identically everywhere).
+        """
+        local = self.local_summary()
+        if self._comm is not None and getattr(self._comm, "host_size", 1) > 1:
+            summaries = self._comm.allgather_obj(local)
+            summaries = sorted(summaries, key=lambda s: s.get("rank", 0))
+        else:
+            summaries = [local]
+        if reset:
+            self._durations.clear()
+        return straggler_report(summaries, threshold=self.threshold)
+
+
+class StepTelemetry:
+    """Per-step timing breakdown recorder the updaters drive.
+
+    Installed on an updater (``updater.telemetry = StepTelemetry(...)``,
+    normally by the ``MetricsReport`` extension); when it is ``None`` the
+    updater takes its untimed fast path, so a disabled run makes zero
+    observability calls per iteration.
+
+    Phases per step (host clock, monotonic):
+
+    * ``data_load``    — pulling the batch from the iterator (masked time
+                         when a PrefetchIterator is in front);
+    * ``host_put``     — assembling/sharding the global device batch;
+    * ``dispatch``     — the jitted step call returning (async dispatch:
+                         tracing + enqueue, not execution);
+    * ``device_block`` — blocking on the step's loss, i.e. time the host
+                         waits on the device (compute + collectives).
+    """
+
+    PHASES = ("data_load", "host_put", "dispatch", "device_block")
+
+    def __init__(self, registry=None, comm=None,
+                 straggler_threshold: float = 1.5,
+                 window_size: int = 512):
+        from chainermn_tpu.observability import registry as _registry
+
+        reg = registry or _registry.get_registry()
+        self.registry = reg
+        self._phase_hist = reg.histogram(
+            "step_phase_seconds", "per-step phase breakdown")
+        self._step_hist = reg.histogram(
+            "step_seconds", "full host-visible step wall time")
+        self._examples = reg.counter(
+            "train_examples", "global examples consumed")
+        self._iterations = reg.counter("train_iterations", "optimizer steps")
+        self.straggler = StragglerDetector(
+            comm, threshold=straggler_threshold, window_size=window_size)
+        self.last: Optional[dict] = None
+
+    def record_step(self, data_load: float, host_put: float, dispatch: float,
+                    device_block: float, examples: int) -> None:
+        total = data_load + host_put + dispatch + device_block
+        self._phase_hist.observe(data_load, phase="data_load")
+        self._phase_hist.observe(host_put, phase="host_put")
+        self._phase_hist.observe(dispatch, phase="dispatch")
+        self._phase_hist.observe(device_block, phase="device_block")
+        self._step_hist.observe(total)
+        self._examples.inc(examples)
+        self._iterations.inc()
+        self.straggler.record(total)
+        self.last = {
+            "data_load_s": data_load,
+            "host_put_s": host_put,
+            "dispatch_s": dispatch,
+            "device_block_s": device_block,
+            "step_s": total,
+            "examples": examples,
+        }

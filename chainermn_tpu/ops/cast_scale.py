@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from chainermn_tpu.utils import pvary
+from chainermn_tpu.utils import pvary, typeof
 
 _LANE = 128
 _BLOCK_ROWS = 256  # 256 x 128 f32 = 128 KiB per buffer; in+out fit VMEM easily
@@ -50,9 +50,19 @@ def cast_scale(x: jnp.ndarray, target_dtype: Optional[jnp.dtype], scale: float):
     orig_shape = x.shape
     flat = x.reshape(-1)
     n = flat.shape[0]
-    in_vma = getattr(jax.typeof(flat), "vma", None)
+    in_vma = getattr(typeof(flat), "vma", None)
+    in_spmd = bool(in_vma)
+    if in_vma is None:
+        # pre-vma jax: no vma metadata to inspect — detect "inside a
+        # shard_map/axis-bound trace" from the axis env instead (there is
+        # no shard_map replication rule for pallas_call there either)
+        try:
+            from jax._src import core as _src_core
+            in_spmd = bool(_src_core.get_axis_env().axis_sizes)
+        except Exception:
+            pass
     interpret = jax.default_backend() != "tpu"
-    if interpret and in_vma:
+    if interpret and in_spmd:
         # jax's HLO interpreter for pallas is not vma-aware (its internal
         # dynamic_slice mixes varying/invariant operands and trips
         # check_vma), so inside a shard_map off-TPU we emit the XLA-fused
@@ -80,7 +90,7 @@ def cast_scale(x: jnp.ndarray, target_dtype: Optional[jnp.dtype], scale: float):
     # Under shard_map with vma-checking, the out aval must carry the same
     # varying-across-mesh-axes set as the input (a cast is rank-local), and
     # every kernel input must share it.
-    vma = getattr(jax.typeof(x2), "vma", None)
+    vma = getattr(typeof(x2), "vma", None)
     if vma is not None:
         if vma:
             s_arr = pvary(s_arr, tuple(vma))

@@ -27,6 +27,8 @@ from __future__ import annotations
 from typing import Any, Callable, NamedTuple
 
 import jax
+
+from chainermn_tpu.utils import shard_map as _shard_map
 import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -413,7 +415,7 @@ def make_train_step(
             # parameter chain) on every preceding step, so reading it to
             # host is a fence over the whole scan.
             return (*state, *jax.tree.map(lambda a: a[-1], tail))
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         inner,
         mesh=comm.mesh,
         in_specs=in_specs,

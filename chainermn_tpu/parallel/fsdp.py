@@ -44,6 +44,14 @@ from __future__ import annotations
 from typing import Any, Callable, NamedTuple
 
 import jax
+
+from chainermn_tpu.utils import shard_map as _shard_map
+from chainermn_tpu.utils import _native_shard_map
+
+# Pre-vma jax transposes psum to psum instead of the identity broadcast,
+# so a global_loss objective (psum'd inside loss_fn) comes back with its
+# gradient inflated by the world size; the step divides it back out.
+_LEGACY_PSUM_TRANSPOSE = _native_shard_map is None
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -263,6 +271,8 @@ def make_fsdp_train_step(
             # the loss was already psum-normalized inside loss_fn, so
             # the summed shard grads ARE the global gradient.)
             gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
+        elif _LEGACY_PSUM_TRANSPOSE:
+            gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
         updates, inner = optimizer.update(gshards, inner, shards)
         shards = optax.apply_updates(shards, updates)
 
@@ -291,7 +301,7 @@ def make_fsdp_train_step(
     if not with_model_state:
         def inner_fn(state, batch):  # noqa: F811
             return step(state, None, batch)
-    mapped = jax.shard_map(inner_fn, mesh=comm.mesh,
+    mapped = _shard_map(inner_fn, mesh=comm.mesh,
                            in_specs=in_specs, out_specs=out_specs,
                            check_vma=check_vma)
     donate_argnums = ((0, 1) if with_model_state else (0,)) if donate else ()

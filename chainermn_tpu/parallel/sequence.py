@@ -40,6 +40,7 @@ import functools
 from typing import Callable, Optional
 
 import jax
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -147,17 +148,24 @@ def _ring_attention_kernel(q, k, v, axis_name, *, causal, sm_scale, attn_fn):
     from chainermn_tpu.utils import pvary
 
     size = _axis_size(axis_name)
-    me = lax.axis_index(axis_name)
+    # Only the causal mask consumes the global block offsets; computing
+    # axis_index in the non-causal trace would leave a dead PartitionId
+    # that XLA hoists out of the manual region and then refuses to
+    # partition under jit.
+    me = lax.axis_index(axis_name) if causal else None
     b, t_local, h, d = q.shape
     sentinel = 1e29  # kernel marks fully-masked rows with lse ~ 1e30
 
     def fold(carry, step):
         k_blk, v_blk, o_run, lse_run = carry
-        src = (me - step) % size
+        if causal:
+            src = (me - step) % size
+            offsets = dict(q_offset=me * t_local, kv_offset=src * t_local)
+        else:
+            offsets = {}
         o_blk, lse_blk = attn_fn(
             q, k_blk, v_blk, causal=causal, sm_scale=sm_scale,
-            q_offset=me * t_local, kv_offset=src * t_local,
-            return_lse=True)
+            return_lse=True, **offsets)
         # sentinel rows attended nothing in this block -> merge weight 0
         lse_b = jnp.where(lse_blk >= sentinel, -jnp.inf, lse_blk)
         m = jnp.maximum(lse_run, lse_b)

@@ -237,12 +237,32 @@ class SocketControlPlane(ControlPlane):
         self.rank = rank
         self.size = size
         self._tp = transport or transport_mod.create_transport(rank, size, coordinator)
+        # Observability seam, bound once at construction (None when off, so
+        # the DCN path adds no per-message work): pickled wire bytes and
+        # message counts by direction — the heartbeat/straggler traffic and
+        # object-plane payloads of a multi-controller run.
+        self._obs_msgs = self._obs_bytes = None
+        from chainermn_tpu.observability import enabled, get_registry
+        if enabled():
+            reg = get_registry()
+            self._obs_msgs = reg.counter(
+                "control_plane_messages", "DCN control-plane messages")
+            self._obs_bytes = reg.counter(
+                "control_plane_bytes", "pickled DCN control-plane bytes")
 
     def send_obj(self, obj, dest, tag=0):
-        self._tp.send(dest, tag, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._obs_msgs is not None:
+            self._obs_msgs.inc(direction="send")
+            self._obs_bytes.inc(len(payload), direction="send")
+        self._tp.send(dest, tag, payload)
 
     def recv_obj(self, source, tag=0):
-        return pickle.loads(self._tp.recv(source, tag))
+        payload = self._tp.recv(source, tag)
+        if self._obs_msgs is not None:
+            self._obs_msgs.inc(direction="recv")
+            self._obs_bytes.inc(len(payload), direction="recv")
+        return pickle.loads(payload)
 
     def shutdown(self):
         self._tp.close()

@@ -30,20 +30,36 @@ class LogReport:
 
     Runs every iteration (it must see each observation); ``trigger`` here is
     the *emit* cadence, mirroring Chainer's LogReport semantics.
+
+    Output formats: ``format="json"`` (default) keeps the reference's
+    one-JSON-array file but writes it atomically (tmp file + rename — a
+    crash mid-write can no longer truncate the log, readers never see a
+    torn file).  ``format="jsonl"`` appends one record per line instead —
+    O(record) per emit rather than O(run-so-far), the right choice for
+    long runs; it shares the sink with the observability metrics JSONL.
+    A ``.jsonl`` filename implies ``format="jsonl"``.
     """
 
     priority = 50
     name = "LogReport"
     trigger = (1, "iteration")  # called every iteration; emits on _emit
 
-    def __init__(self, trigger=(1, "epoch"), filename: str = "log"):
+    def __init__(self, trigger=(1, "epoch"), filename: str = "log",
+                 format: Optional[str] = None):
+        if format is None:
+            format = "jsonl" if filename.endswith(".jsonl") else "json"
+        if format not in ("json", "jsonl"):
+            raise ValueError(f"format must be 'json' or 'jsonl', got "
+                             f"{format!r}")
         self._emit = trigger
         self._filename = filename
+        self._format = format
         self._accum: dict = {}
         self._counts: dict = {}
         self.log: List[dict] = []
 
     def __call__(self, trainer):
+        from chainermn_tpu.observability import append_jsonl, atomic_write_json
         from chainermn_tpu.training.trainer import _trigger_fires
 
         for k, v in trainer.observation.items():
@@ -62,8 +78,151 @@ class LogReport:
         })
         self.log.append(record)
         self._accum, self._counts = {}, {}
-        with open(os.path.join(trainer.out, self._filename), "w") as f:
-            json.dump(self.log, f, indent=1, default=float)
+        path = os.path.join(trainer.out, self._filename)
+        if self._format == "jsonl":
+            append_jsonl(path, record)
+        else:
+            atomic_write_json(path, self.log)
+
+
+class MetricsReport:
+    """Runtime-observability extension: per-step timing breakdown,
+    communicator counters, and the periodic cross-rank straggler report,
+    all appended to one metrics JSONL (schema shared with the benchmark
+    emitters; render with ``tools/obs_report.py``).
+
+    On ``initialize`` it installs a
+    :class:`~chainermn_tpu.observability.StepTelemetry` on the updater —
+    but only when observability is enabled
+    (``chainermn_tpu.observability.enable()`` or the
+    ``CHAINERMN_TPU_OBSERVABILITY`` env var); otherwise the extension is
+    inert and the trainer hot path stays untimed.
+
+    Add it on **every** rank (the straggler report allgathers summaries
+    over the control plane, so all ranks must participate at the same
+    trigger); only rank 0 writes files.
+    """
+
+    priority = 45
+    name = "MetricsReport"
+    trigger = (1, "iteration")  # called every iteration; emits on _emit
+
+    def __init__(self, trigger=(1, "epoch"), filename: str = "metrics.jsonl",
+                 straggler_every: int = 1, straggler_threshold: float = 1.5,
+                 prometheus: Optional[str] = None, registry=None,
+                 tokens_per_example: Optional[int] = None):
+        if straggler_every < 1:
+            raise ValueError(f"straggler_every must be >= 1, got "
+                             f"{straggler_every}")
+        self._emit = trigger
+        self._filename = filename
+        self._straggler_every = straggler_every
+        self._straggler_threshold = straggler_threshold
+        self._prometheus = prometheus
+        self._registry = registry
+        self._tokens_per_example = tokens_per_example
+        self._active = False
+
+    def initialize(self, trainer):
+        from chainermn_tpu import observability as obs
+
+        self._active = obs.enabled()
+        if not self._active:
+            return
+        reg = self._registry if self._registry is not None else \
+            obs.get_registry()
+        comm = trainer.updater.comm
+        self._reg = reg
+        self._comm = comm
+        self._tele = obs.StepTelemetry(
+            registry=reg, comm=comm,
+            straggler_threshold=self._straggler_threshold)
+        trainer.updater.telemetry = self._tele
+        self._is_writer = getattr(comm, "rank", 0) == 0
+        self._path = os.path.join(trainer.out, self._filename)
+        self._win = {"steps": 0, "examples": 0,
+                     **{p: 0.0 for p in self._tele.PHASES}}
+        self._t_last_emit = time.perf_counter()
+        self._emits = 0
+
+    def _emit_record(self, trainer) -> dict:
+        import time as _t
+
+        now = time.perf_counter()
+        dt = max(now - self._t_last_emit, 1e-9)
+        self._t_last_emit = now
+        w = self._win
+        n = max(w["steps"], 1)
+        record = {
+            "kind": "step_report",
+            "ts": _t.time(),
+            "iteration": trainer.updater.iteration,
+            "epoch": trainer.updater.epoch,
+            "elapsed_time": trainer.elapsed_time,
+            "steps": w["steps"],
+            "examples_per_sec": w["examples"] / dt,
+            "steps_per_sec": w["steps"] / dt,
+        }
+        if self._tokens_per_example:
+            record["tokens_per_sec"] = (
+                w["examples"] * self._tokens_per_example / dt)
+        for p in self._tele.PHASES:
+            record[f"{p}_s_mean"] = w[p] / n
+        record["step_s_mean"] = sum(w[p] for p in self._tele.PHASES) / n
+        self._win = {"steps": 0, "examples": 0,
+                     **{p: 0.0 for p in self._tele.PHASES}}
+        return record
+
+    def __call__(self, trainer):
+        from chainermn_tpu.observability import (
+            append_jsonl, write_prometheus, write_snapshot_jsonl)
+        from chainermn_tpu.training.trainer import _trigger_fires
+
+        if not self._active:
+            return
+        last = self._tele.last
+        if last is not None:
+            w = self._win
+            w["steps"] += 1
+            w["examples"] += last["examples"]
+            for p in self._tele.PHASES:
+                w[p] += last[f"{p}_s"]
+            self._tele.last = None
+        if not _trigger_fires(self._emit, trainer.updater):
+            return
+        record = self._emit_record(trainer)
+        self._emits += 1
+        straggler = None
+        if self._emits % self._straggler_every == 0:
+            # COLLECTIVE over the control plane — every rank reaches this
+            # at the same trigger; do not gate it on the writer rank.
+            straggler = self._tele.straggler.report()
+        if not self._is_writer:
+            return
+        append_jsonl(self._path, record)
+        write_snapshot_jsonl(self._path, self._reg.snapshot(),
+                             rank=self._comm.rank)
+        if straggler is not None:
+            straggler = dict(straggler,
+                             iteration=trainer.updater.iteration)
+            append_jsonl(self._path, straggler)
+        if self._prometheus:
+            write_prometheus(self._prometheus, self._reg.snapshot())
+
+    def finalize(self, trainer):
+        from chainermn_tpu.observability import append_jsonl, write_snapshot_jsonl
+
+        if not self._active or self._win["steps"] == 0:
+            return
+        record = self._emit_record(trainer)
+        straggler = self._tele.straggler.report()
+        if not self._is_writer:
+            return
+        append_jsonl(self._path, record)
+        write_snapshot_jsonl(self._path, self._reg.snapshot(),
+                             rank=self._comm.rank)
+        append_jsonl(self._path, dict(straggler,
+                                      iteration=trainer.updater.iteration))
 
 
 class PrintReport:

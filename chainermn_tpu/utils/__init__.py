@@ -23,7 +23,31 @@ def pvary(x, axes):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, missing, to="varying")
-    return jax.lax.pvary(x, missing)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, missing)
+    return x  # pre-vma jax: shard_map's check_rep tracks replication itself
+
+
+_native_shard_map = getattr(jax, "shard_map", None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    """``jax.shard_map`` where this jax exports it (>= 0.5), else the
+    ``jax.experimental.shard_map`` spelling of older versions, with the
+    ``check_vma``/``check_rep`` kwarg rename translated."""
+    if _native_shard_map is not None:
+        return _native_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    # Old shard_map's check_rep raises spurious "Scan carry ... mismatched
+    # replication types" errors on valid programs (the error text itself
+    # suggests check_rep=False); default it off unless the caller asked.
+    kwargs.setdefault("check_rep", False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
 
 
 def axis_size(axis_name) -> int:
@@ -34,4 +58,26 @@ def axis_size(axis_name) -> int:
     return jax.lax.psum(1, axis_name)
 
 
-__all__ = ["axis_size", "pvary"]
+def typeof(x):
+    """``jax.typeof`` where available; older versions fall back to the
+    abstract value, which simply lacks ``vma`` metadata (callers probe it
+    with ``getattr(..., "vma", None)``)."""
+    t = getattr(jax, "typeof", None)
+    if t is not None:
+        return t(x)
+    from jax import core
+
+    return core.get_aval(x)
+
+
+def _install_jax_shard_map_alias() -> None:
+    # jax < 0.5 has no jax.shard_map; alias the compat wrapper onto the
+    # jax namespace so tests/examples written against the current API
+    # (jax.shard_map(..., check_vma=...)) run unchanged on this version.
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+
+
+_install_jax_shard_map_alias()
+
+__all__ = ["axis_size", "pvary", "shard_map", "typeof"]

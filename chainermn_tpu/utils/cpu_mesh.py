@@ -15,6 +15,38 @@ and re-configure.  That fragile sequence lives here, once, shared by
 from __future__ import annotations
 
 
+def _set_cpu_device_flags(n: int) -> None:
+    """Request ``n`` CPU devices on whichever knob this jax version has.
+
+    jax >= 0.5 exposes ``jax_num_cpu_devices`` (re-readable after a backend
+    reset); older versions only honor ``--xla_force_host_platform_device_count``
+    in XLA_FLAGS, which the CPU client latches at its FIRST creation — so on
+    those versions this must run before any backend exists.
+    """
+    import os
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}")
+
+
+def _backend_uninitialized() -> bool:
+    """True when no XLA client has been created yet in this process (so
+    CPU-mesh config can still take effect on every jax version)."""
+    try:
+        from jax._src import xla_bridge
+
+        return not xla_bridge._backends
+    except Exception:
+        return False
+
+
 def reset_to_cpu_mesh(n: int) -> None:
     """Tear down the current JAX backend and bring up ``n`` CPU devices."""
     import jax
@@ -22,7 +54,7 @@ def reset_to_cpu_mesh(n: int) -> None:
 
     jex.backend.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    _set_cpu_device_flags(n)
     devs = jax.devices()
     assert jax.default_backend() == "cpu" and len(devs) >= n, (
         f"CPU mesh bootstrap failed: backend={jax.default_backend()} "
@@ -33,6 +65,12 @@ def ensure_cpu_mesh(n: int = 8) -> None:
     """Guarantee a CPU backend with at least ``n`` devices (tests)."""
     import jax
 
+    if _backend_uninitialized():
+        # Configure BEFORE the first backend is created: on jax < 0.5 the
+        # CPU device count is read from XLA_FLAGS exactly once, at first
+        # client creation, and a post-hoc reset cannot grow the mesh.
+        jax.config.update("jax_platforms", "cpu")
+        _set_cpu_device_flags(n)
     try:
         ok = jax.default_backend() == "cpu" and len(jax.devices()) >= n
     except Exception:

@@ -52,15 +52,38 @@ def _load_trace(trace_dir: str) -> Tuple[dict, Dict[int, str]]:
     return data, pids
 
 
+def _detect_device_track(pids: Dict[int, str]) -> str:
+    """Pick the device track from the trace's process names.
+
+    Prefers a TPU track (lowest-numbered), falls back to the first
+    ``/device:`` track of any backend — so the same analysis code reads
+    CPU-mesh and GPU captures without callers hard-coding
+    ``/device:TPU:0`` (which silently sums zero events off-TPU).
+    """
+    tracks = sorted(v for v in pids.values() if v.startswith("/device:"))
+    if not tracks:
+        raise ValueError(
+            "no /device: track in trace (process names: "
+            f"{sorted(set(pids.values()))}) — not a device capture?")
+    for t in tracks:
+        if t.startswith("/device:TPU:"):
+            return t
+    return tracks[0]
+
+
 def device_op_times(trace_dir: str,
-                    device: str = "/device:TPU:0") -> Dict[str, Tuple[float, int]]:
+                    device: Optional[str] = None) -> Dict[str, Tuple[float, int]]:
     """Sum device-side op durations from a profiler capture.
 
     Returns ``{op_name: (total_ms, count)}`` for complete events on the
     given device track, excluding the per-program wrapper events
     (``jit_*`` and bare step numbers) so the values are real op time.
+    ``device=None`` auto-detects the track (TPU preferred, else the
+    first ``/device:`` process in the capture).
     """
     data, pids = _load_trace(trace_dir)
+    if device is None:
+        device = _detect_device_track(pids)
     acc: Dict[str, List[float]] = collections.defaultdict(lambda: [0.0, 0])
     for e in data["traceEvents"]:
         if (e.get("ph") == "X" and "dur" in e
@@ -75,7 +98,7 @@ def device_op_times(trace_dir: str,
 
 
 def top_ops(trace_dir: str, n: int = 20, by_category: bool = False,
-            device: str = "/device:TPU:0") -> List[Tuple[str, float, int]]:
+            device: Optional[str] = None) -> List[Tuple[str, float, int]]:
     """Top-``n`` ops (or name-categories, with trailing ``.N`` stripped)
     by total device time: ``[(name, total_ms, count), ...]`` descending."""
     times = device_op_times(trace_dir, device=device)
@@ -93,7 +116,7 @@ def top_ops(trace_dir: str, n: int = 20, by_category: bool = False,
 
 def device_time(fn: Callable, args: tuple, steps: int = 5, warmup: int = 2,
                 trace_dir: Optional[str] = None,
-                device: str = "/device:TPU:0") -> float:
+                device: Optional[str] = None) -> float:
     """Per-call device-side milliseconds of ``fn(*args)``.
 
     Captures a profiler trace around ``steps`` calls and sums the device

@@ -67,7 +67,18 @@ def main():
                              "e.g. bfloat16")
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--observability", action="store_true",
+                        help="record runtime metrics (collective bytes/"
+                             "latency, per-step phase breakdown, straggler "
+                             "report) to <out>/metrics.jsonl; render with "
+                             "tools/obs_report.py")
     args = parser.parse_args()
+
+    # The switch must flip before communicators/iterators are built —
+    # observability call sites bind once at construction time.
+    if args.observability:
+        from chainermn_tpu import observability
+        observability.enable()
 
     # multi-controller bootstrap from the CHAINERMN_TPU_* env contract
     # (the reference's mpiexec launch shape); no-op single-controller
@@ -75,6 +86,7 @@ def main():
     comm = chainermn_tpu.create_communicator(
         args.communicator, intra_size=args.intra_size,
         allreduce_grad_dtype=args.allreduce_grad_dtype)
+    comm = chainermn_tpu.instrument_communicator(comm)  # no-op when disabled
 
     if comm.rank == 0:
         print("==========================================")
@@ -139,6 +151,11 @@ def main():
         test_iter, make_eval_fn(comm, metrics_fn), comm)
     evaluator = create_multi_node_evaluator(evaluator, comm)
     trainer.extend(evaluator, trigger=(1, "epoch"))
+
+    # MetricsReport goes on EVERY rank (its straggler report is a
+    # control-plane collective); it only writes files on rank 0.
+    if args.observability:
+        trainer.extend(extensions.MetricsReport(trigger=(1, "epoch")))
 
     # reporting is gated to rank 0, exactly like the reference example
     if comm.rank == 0:

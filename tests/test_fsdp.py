@@ -156,7 +156,7 @@ class TestVariants:
                                     donate=False)
         batch = put_global_batch(comm, data)
         losses = []
-        for _ in range(20):
+        for _ in range(25):
             state, loss = step(state, batch)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5

@@ -115,3 +115,63 @@ def test_empty_ledger_is_not_green(tmp_path, monkeypatch):
         assert doc["ok"] is False and doc["checks"] == {}
     finally:
         sys.path.pop(0)
+
+
+def test_obs_report_renders_metrics_jsonl(tmp_path):
+    """tools/obs_report.py turns a mixed metrics JSONL (metric lines +
+    step/straggler/bench records) into the four tables, exit 0."""
+    path = tmp_path / "metrics.jsonl"
+    records = [
+        {"kind": "metric", "name": "comm_collective_calls",
+         "type": "counter", "labels": {"op": "allreduce_grad",
+                                       "comm": "NaiveCommunicator"},
+         "value": 3, "ts": 1.0},
+        {"kind": "metric", "name": "comm_collective_bytes",
+         "type": "counter", "labels": {"op": "allreduce_grad",
+                                       "comm": "NaiveCommunicator",
+                                       "dtype": "bfloat16"},
+         "value": 1048576, "ts": 1.0},
+        {"kind": "metric", "name": "comm_collective_seconds",
+         "type": "histogram", "labels": {"op": "allreduce_grad",
+                                         "comm": "NaiveCommunicator"},
+         "count": 3, "sum": 0.03, "min": 0.005, "max": 0.015,
+         "quantiles": {"0.5": 0.01, "0.9": 0.014, "0.99": 0.015}, "ts": 1.0},
+        {"kind": "step_report", "iteration": 10, "epoch": 1, "steps": 10,
+         "examples_per_sec": 1234.5, "data_load_s_mean": 0.001,
+         "host_put_s_mean": 0.002, "dispatch_s_mean": 0.003,
+         "device_block_s_mean": 0.004, "step_s_mean": 0.01},
+        {"kind": "straggler_report", "n_ranks": 2, "median_step_s": 0.01,
+         "threshold": 1.5,
+         "ranks": [{"rank": 0, "count": 10, "mean_s": 0.01, "p50_s": 0.01,
+                    "p95_s": 0.012, "max_s": 0.013},
+                   {"rank": 1, "count": 10, "mean_s": 0.03, "p50_s": 0.03,
+                    "p95_s": 0.031, "max_s": 0.032}],
+         "stragglers": [{"rank": 1, "mean_s": 0.03,
+                         "ratio_vs_median": 3.0}]},
+        {"kind": "bench_allreduce", "communicator": "naive", "devices": 8,
+         "payload_mib": 64.0, "time_ms": 10.0, "busbw_gbps": 11.2},
+    ]
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         str(path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "per-step summary" in out
+    assert "per-collective summary" in out
+    assert "allreduce_grad" in out and "1.0MiB" in out
+    assert "STRAGGLER" in out          # rank 1 flagged in the table
+    assert "bench_allreduce" in out
+    # empty file is a loud error, not an empty report
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         str(empty)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 1

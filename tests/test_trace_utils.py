@@ -33,3 +33,59 @@ def test_device_time_and_op_tables(tmp_path):
 def test_missing_trace_raises(tmp_path):
     with pytest.raises(FileNotFoundError, match="no trace"):
         device_op_times(str(tmp_path / "nothing"))
+
+
+def _write_synthetic_trace(trace_dir, tracks, events):
+    """Minimal profiler-shaped capture: process_name metadata + complete
+    events, gzipped where _load_trace expects it."""
+    import gzip
+    import json
+    import os
+
+    d = os.path.join(str(trace_dir), "plugins", "profile", "run")
+    os.makedirs(d, exist_ok=True)
+    trace_events = [
+        {"ph": "M", "name": "process_name", "pid": pid,
+         "args": {"name": name}}
+        for pid, name in tracks.items()
+    ]
+    trace_events += [
+        {"ph": "X", "pid": pid, "name": name, "dur": dur_us, "ts": 0}
+        for pid, name, dur_us in events
+    ]
+    path = os.path.join(d, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": trace_events}, f)
+
+
+def test_device_autodetect_prefers_tpu_track(tmp_path):
+    """device=None picks the TPU track when present — only its events are
+    summed, not the CPU track's or the host threads'."""
+    _write_synthetic_trace(
+        tmp_path,
+        tracks={1: "/device:TPU:0", 2: "/device:CPU:0", 3: "python"},
+        events=[(1, "fusion.1", 2000), (1, "all-reduce", 1000),
+                (2, "cpu-op", 9000), (3, "host-thing", 500)])
+    times = device_op_times(str(tmp_path))  # no device argument
+    assert set(times) == {"fusion.1", "all-reduce"}
+    assert times["fusion.1"] == (2.0, 1)
+    rows = top_ops(str(tmp_path), n=5)
+    assert rows[0][0] == "fusion.1"
+
+
+def test_device_autodetect_falls_back_to_first_device_track(tmp_path):
+    """No TPU track (a CPU-mesh capture): the first /device: process is
+    used instead of silently summing zero events."""
+    _write_synthetic_trace(
+        tmp_path,
+        tracks={7: "/device:CPU:0", 8: "python"},
+        events=[(7, "cpu-op", 4000), (8, "host-thing", 500)])
+    times = device_op_times(str(tmp_path))
+    assert times == {"cpu-op": (4.0, 1)}
+
+
+def test_device_autodetect_no_device_track_raises(tmp_path):
+    _write_synthetic_trace(tmp_path, tracks={3: "python"},
+                           events=[(3, "host-thing", 500)])
+    with pytest.raises(ValueError, match="no /device: track"):
+        device_op_times(str(tmp_path))

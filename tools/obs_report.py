@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Render an observability metrics JSONL into human-readable tables.
+
+Reads the one-record-per-line file the runtime sinks write — the
+``MetricsReport`` extension (``<out>/metrics.jsonl``), ``bench.py
+--metrics`` and ``benchmarks/bench_allreduce.py --metrics`` all share the
+schema — and prints:
+
+* per-collective summary   (calls / payload bytes / host latency, from
+                            ``comm_collective_*`` metric lines);
+* per-step summary         (phase breakdown + throughput, from
+                            ``step_report`` lines);
+* straggler section        (latest ``straggler_report`` line);
+* bench results            (``bench`` / ``bench_allreduce`` lines).
+
+Usage::
+
+    python tools/obs_report.py result/metrics.jsonl
+    python tools/obs_report.py result/metrics.jsonl --section collectives
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v:.3f}s"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    def line(cells):
+        return "  ".join(c.rjust(w) for c, w in zip(cells, widths))
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def _latest_metric_lines(records: List[dict]) -> Dict[tuple, dict]:
+    """Metric snapshot lines are cumulative — keep only the newest line
+    per (name, labels) series."""
+    latest: Dict[tuple, dict] = {}
+    for r in records:
+        if r.get("kind") != "metric":
+            continue
+        key = (r.get("name"), tuple(sorted((r.get("labels") or {}).items())))
+        latest[key] = r
+    return latest
+
+
+def collectives_section(records: List[dict]) -> str:
+    latest = _latest_metric_lines(records)
+    ops: Dict[tuple, dict] = {}
+    for (name, labels), r in latest.items():
+        ld = dict(labels)
+        op = ld.get("op")
+        if op is None or not str(name).startswith(
+                ("comm_collective", "comm_object")):
+            continue
+        row = ops.setdefault((op, ld.get("comm", "?")), {})
+        if name in ("comm_collective_calls", "comm_object_calls"):
+            row["calls"] = row.get("calls", 0.0) + r.get("value", 0.0)
+        elif name == "comm_collective_bytes":
+            row["bytes"] = row.get("bytes", 0.0) + r.get("value", 0.0)
+            row.setdefault("dtypes", set()).add(ld.get("dtype", "?"))
+        elif name in ("comm_collective_seconds", "comm_object_seconds"):
+            row["p50"] = (r.get("quantiles") or {}).get("0.5")
+            row["count"] = r.get("count")
+            row["sum"] = r.get("sum")
+    if not ops:
+        return "per-collective: no comm_collective_*/comm_object_* metrics"
+    rows = []
+    for (op, comm), d in sorted(ops.items()):
+        calls = d.get("calls", 0)
+        total_s = d.get("sum")
+        rows.append([
+            op, comm, f"{int(calls)}",
+            _fmt_bytes(d.get("bytes", 0.0)) if "bytes" in d else "-",
+            ",".join(sorted(d.get("dtypes", []))) or "-",
+            _fmt_s(d.get("p50")),
+            _fmt_s(total_s) if total_s is not None else "-",
+        ])
+    return "per-collective summary\n" + _table(
+        ["op", "comm", "calls", "bytes", "dtype", "p50", "total"], rows)
+
+
+def steps_section(records: List[dict]) -> str:
+    reps = [r for r in records if r.get("kind") == "step_report"]
+    if not reps:
+        return "per-step: no step_report records"
+    rows = []
+    for r in reps:
+        rows.append([
+            str(r.get("iteration", "-")), str(r.get("epoch", "-")),
+            str(r.get("steps", "-")),
+            _fmt_s(r.get("data_load_s_mean")),
+            _fmt_s(r.get("host_put_s_mean")),
+            _fmt_s(r.get("dispatch_s_mean")),
+            _fmt_s(r.get("device_block_s_mean")),
+            _fmt_s(r.get("step_s_mean")),
+            f"{r.get('examples_per_sec', 0.0):.1f}",
+        ])
+    return "per-step summary\n" + _table(
+        ["iter", "epoch", "steps", "data_load", "host_put", "dispatch",
+         "dev_block", "step", "ex/s"], rows)
+
+
+def straggler_section(records: List[dict]) -> str:
+    reps = [r for r in records if r.get("kind") == "straggler_report"]
+    if not reps:
+        return "straggler: no straggler_report records"
+    r = reps[-1]
+    head = (f"straggler report (latest, n_ranks={r.get('n_ranks')}, "
+            f"median={_fmt_s(r.get('median_step_s'))}, "
+            f"threshold={r.get('threshold')}x)")
+    rows = []
+    flagged = {s.get("rank") for s in r.get("stragglers", [])}
+    for s in r.get("ranks", []):
+        rows.append([
+            str(s.get("rank", "-")), str(s.get("count", "-")),
+            _fmt_s(s.get("mean_s")), _fmt_s(s.get("p50_s")),
+            _fmt_s(s.get("p95_s")), _fmt_s(s.get("max_s")),
+            "STRAGGLER" if s.get("rank") in flagged else "",
+        ])
+    return head + "\n" + _table(
+        ["rank", "steps", "mean", "p50", "p95", "max", ""], rows)
+
+
+def bench_section(records: List[dict]) -> str:
+    reps = [r for r in records
+            if r.get("kind") in ("bench", "bench_allreduce")]
+    if not reps:
+        return "bench: no bench records"
+    keys: List[str] = []
+    for r in reps:
+        for k in r:
+            if k not in ("kind", "ts") and k not in keys:
+                keys.append(k)
+    rows = [[r["kind"]] + [str(r.get(k, "-")) for k in keys] for r in reps]
+    return "bench results\n" + _table(["kind"] + keys, rows)
+
+
+SECTIONS = {
+    "collectives": collectives_section,
+    "steps": steps_section,
+    "straggler": straggler_section,
+    "bench": bench_section,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="metrics JSONL file")
+    ap.add_argument("--section", choices=sorted(SECTIONS),
+                    help="print only one section")
+    args = ap.parse_args(argv)
+
+    from chainermn_tpu.observability import read_jsonl
+
+    records = read_jsonl(args.path)
+    if not records:
+        print(f"no records in {args.path}", file=sys.stderr)
+        return 1
+    names = [args.section] if args.section else \
+        ["steps", "collectives", "straggler", "bench"]
+    print("\n\n".join(SECTIONS[n](records) for n in names))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
