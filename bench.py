@@ -42,7 +42,7 @@ TRAIN_GFLOP_PER_IMAGE = 12.3
 # The round-2 loss was "remote_compile: response body closed before all
 # bytes were read".
 from chainermn_tpu.utils.retry import retry_transient  # noqa: E402
-from chainermn_tpu.utils.tpu_info import peak_tflops as _peak_tflops  # noqa: E402
+from chainermn_tpu.utils.tpu_info import peak_tflops_info as _peak_tflops_info  # noqa: E402
 
 
 def log(*a):
@@ -159,9 +159,15 @@ def run(args) -> dict:
     out["stem"] = stem
     out["scan_steps"] = scan
     if on_tpu:
-        peak = _peak_tflops(jax.devices()[0])
+        dev = jax.devices()[0]
+        peak, matched = _peak_tflops_info(dev)
         mfu = per_chip * TRAIN_GFLOP_PER_IMAGE / 1e3 / peak
         out["mfu"] = round(mfu, 4)
+        out["device_kind"] = getattr(dev, "device_kind", "")
+        if matched is None:
+            # unknown chip: the MFU denominator is an assumption, mark it
+            out["peak_assumed"] = True
+        out["peak_tflops"] = peak
         out["step_ms"] = round(dt / steps * 1e3, 2)
         # Supplementary on-DEVICE per-step time (profiler device track):
         # separates chip time from the ~10 ms/dispatch host/tunnel term so

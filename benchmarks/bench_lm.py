@@ -126,10 +126,15 @@ def run(args) -> dict:
         "train_gflop_per_token": round(gflop_tok, 4),
     }
     if on_tpu:
-        from chainermn_tpu.utils.tpu_info import peak_tflops
+        from chainermn_tpu.utils.tpu_info import peak_tflops_info
 
-        peak = peak_tflops(jax.devices()[0])
+        dev = jax.devices()[0]
+        peak, matched = peak_tflops_info(dev)
         out["mfu"] = round(tok_per_sec * gflop_tok / 1e3 / peak, 4)
+        out["device_kind"] = getattr(dev, "device_kind", "")
+        if matched is None:
+            out["peak_assumed"] = True
+        out["peak_tflops"] = peak
         out["step_ms"] = round(dt / steps * 1e3, 2)
         try:
             from chainermn_tpu.utils.trace import device_time

@@ -134,10 +134,15 @@ def run(args) -> dict:
         "train_gflop_per_image": round(gflop, 4),
     }
     if on_tpu:
-        from chainermn_tpu.utils.tpu_info import peak_tflops
+        from chainermn_tpu.utils.tpu_info import peak_tflops_info
 
-        peak = peak_tflops(jax.devices()[0])
+        dev = jax.devices()[0]
+        peak, matched = peak_tflops_info(dev)
         out["mfu"] = round(per_chip * gflop / 1e3 / peak, 4)
+        out["device_kind"] = getattr(dev, "device_kind", "")
+        if matched is None:
+            out["peak_assumed"] = True
+        out["peak_tflops"] = peak
         out["step_ms"] = round(dt / steps * 1e3, 2)
         try:
             from chainermn_tpu.utils.trace import device_time

@@ -16,12 +16,19 @@ intersect, take max).
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+# Sidecar keys persisted next to the leaf_{i} arrays in each npz: the
+# FSDP sharding layout (world size + shard lengths) so a resume into a
+# mismatched world fails loudly (ADVICE r5).  Underscored names cannot
+# collide with leaf keys.
+_FSDP_META_KEY = "__fsdp_meta__"
 
 
 def _flatten_state(state) -> Tuple[dict, Any]:
@@ -62,12 +69,30 @@ class _MultiNodeCheckpointer:
 
     # -- save / GC -----------------------------------------------------------
     def save(self, state, iteration: int):
-        arrays, _ = _flatten_state(state)
-        # np.savez appends .npz when missing, so the temp name must end in it
-        tmp = self._file(iteration) + ".tmp.npz"
-        np.savez(tmp, **arrays)
-        os.replace(tmp, self._file(iteration))  # atomic publish
-        self._gc()
+        from chainermn_tpu.observability import flight_recorder as _flight
+        from chainermn_tpu.parallel.fsdp import fsdp_layout
+
+        fr = _flight.get_flight_recorder()
+        tok = None
+        if fr is not None:
+            tok = fr.span_begin("checkpoint", "checkpoint_save",
+                                iteration=iteration)
+        try:
+            arrays, _ = _flatten_state(state)
+            layout = fsdp_layout(state)
+            if layout is not None:
+                # persist the FsdpMeta-derived layout so resume() can
+                # validate world size / mode before touching the arrays
+                arrays[_FSDP_META_KEY] = np.array(json.dumps(layout))
+            # np.savez appends .npz when missing, so the temp name must
+            # end in it
+            tmp = self._file(iteration) + ".tmp.npz"
+            np.savez(tmp, **arrays)
+            os.replace(tmp, self._file(iteration))  # atomic publish
+            self._gc()
+        finally:
+            if tok is not None:
+                fr.span_end(tok)
 
     def _gc(self):
         gens = self._local_generations()
@@ -86,22 +111,89 @@ class _MultiNodeCheckpointer:
             common &= set(g)
         return max(common) if common else None
 
+    def _validate_restore(self, arrays: dict, state, leaves, gen: int):
+        """Refuse a world-size or sharding-mode mismatch BEFORE any leaf
+        is restored (ADVICE r5: an FSDP checkpoint silently reloaded into
+        a different world trains on garbage shards).  The supported
+        cross-mode/cross-size path is exporting the full parameters with
+        ``fsdp_full_params`` and re-sharding with ``fsdp_init``."""
+        from chainermn_tpu.parallel.fsdp import fsdp_layout
+
+        raw = arrays.pop(_FSDP_META_KEY, None)
+        saved = json.loads(str(raw)) if raw is not None else None
+        live = fsdp_layout(state)
+        where = f"{self.name}.{gen} (rank {self.comm.rank})"
+        if saved is not None and live is None:
+            raise ValueError(
+                f"checkpoint {where} holds an FSDP-sharded state "
+                f"(world_size={saved['world_size']}) but the resume "
+                f"target is unsharded — export full parameters via "
+                f"fsdp_full_params(state, meta) before saving, or resume "
+                f"into an FsdpState from fsdp_init on the same world")
+        if saved is not None:
+            if saved["world_size"] != self.comm.size:
+                raise ValueError(
+                    f"checkpoint {where} was saved with FSDP "
+                    f"world_size={saved['world_size']} but this world has "
+                    f"comm.size={self.comm.size}; shard layouts are bound "
+                    f"to the world size — restore on a matching world, or "
+                    f"export with fsdp_full_params and re-shard with "
+                    f"fsdp_init (the cross-size/cross-mode path)")
+            if saved["shard_lens"] != live["shard_lens"]:
+                raise ValueError(
+                    f"checkpoint {where} shard layout "
+                    f"{saved['shard_lens']} does not match the live "
+                    f"FsdpState layout {live['shard_lens']} — the model "
+                    f"or packing changed since the save")
+        # Generic leaf-shape validation (also catches a legacy FSDP
+        # checkpoint without the sidecar, or a plain checkpoint resumed
+        # into an FSDP target): every mismatch beats a cryptic unflatten
+        # or a silently mis-sharded device_put.
+        n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            raise ValueError(
+                f"checkpoint {where} has {n_saved} leaves but the resume "
+                f"target has {len(leaves)} — the state structure changed "
+                f"(sharded vs unsharded states do not interchange; "
+                f"fsdp_full_params is the export path)")
+        for i, leaf in enumerate(leaves):
+            want = tuple(getattr(leaf, "shape", ()) or ())
+            got = tuple(arrays[f"leaf_{i}"].shape)
+            if want != got:
+                raise ValueError(
+                    f"checkpoint {where} leaf_{i} has shape {got} but the "
+                    f"resume target expects {want} — likely a world-size "
+                    f"or sharding-mode mismatch (see fsdp_full_params for "
+                    f"the supported cross-mode export)")
+
     def resume(self, state):
         """Restore the latest consistent generation into ``state``'s
         structure.  Returns ``(state, iteration)``; ``iteration`` is None
         when nothing could be resumed (fresh start)."""
+        from chainermn_tpu.observability import flight_recorder as _flight
+
         gen = self.latest_consistent_generation()
         if gen is None:
             return state, None
-        leaves, treedef = jax.tree.flatten(state)
-        with np.load(self._file(gen)) as data:
-            arrays = {k: data[k] for k in data.files}
-        restored = _unflatten_state(arrays, treedef, leaves)
-        # preserve shardings of the live state
-        restored = jax.tree.map(
-            lambda new, old: jax.device_put(new, old.sharding)
-            if hasattr(old, "sharding") else new,
-            restored, state)
+        fr = _flight.get_flight_recorder()
+        tok = None
+        if fr is not None:
+            tok = fr.span_begin("checkpoint", "checkpoint_resume",
+                                generation=gen)
+        try:
+            leaves, treedef = jax.tree.flatten(state)
+            with np.load(self._file(gen)) as data:
+                arrays = {k: data[k] for k in data.files}
+            self._validate_restore(arrays, state, leaves, gen)
+            restored = _unflatten_state(arrays, treedef, leaves)
+            # preserve shardings of the live state
+            restored = jax.tree.map(
+                lambda new, old: jax.device_put(new, old.sharding)
+                if hasattr(old, "sharding") else new,
+                restored, state)
+        finally:
+            if tok is not None:
+                fr.span_end(tok)
         return restored, gen
 
     def finalize(self):

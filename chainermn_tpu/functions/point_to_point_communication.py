@@ -219,12 +219,27 @@ def cross_send(x, communicator, dest_process: int, tag: int = 0):
     grad_shapes = [jax.ShapeDtypeStruct(s, jnp.dtype(d))
                    for (s, d), l in zip(metas, leaves) if _is_inexact(l)]
 
+    # Flight-recorder seam, bound at trace time (None when observability
+    # is off): the blocking host callbacks below are exactly where a
+    # cross-controller hang manifests, so each runs as a tracked span.
+    from chainermn_tpu.observability import flight_recorder as _flight
+    fr = _flight.get_flight_recorder()
+
     def host_send(*np_leaves):
-        communicator.send_obj([np.asarray(a) for a in np_leaves],
-                              dest_process, tag=tag)
+        arrs = [np.asarray(a) for a in np_leaves]
+        if fr is not None:
+            fr.record("p2p_send", peer=dest_process, tag=tag,
+                      nbytes=sum(a.nbytes for a in arrs))
+        communicator.send_obj(arrs, dest_process, tag=tag)
 
     def host_recv_grads():
+        tok = None
+        if fr is not None:
+            tok = fr.span_begin(
+                "p2p", f"recv_grads[src={dest_process},tag={tag}]")
         gs = communicator.recv_obj(dest_process, tag=_GRAD_TAG_OFFSET + tag)
+        if tok is not None:
+            fr.span_end(tok)
         return tuple(np.asarray(g) for g in gs)
 
     @jax.custom_vjp
@@ -286,12 +301,25 @@ def cross_recv(communicator, source_process: int, tag: int = 0,
     shapes = [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in metas]
     inexact = [jnp.issubdtype(s.dtype, jnp.inexact) for s in shapes]
 
+    from chainermn_tpu.observability import flight_recorder as _flight
+    fr = _flight.get_flight_recorder()
+
     def host_recv():
+        tok = None
+        if fr is not None:
+            tok = fr.span_begin(
+                "p2p", f"recv[src={source_process},tag={tag}]")
         vals = communicator.recv_obj(source_process, tag=tag)
+        if tok is not None:
+            fr.span_end(tok)
         return tuple(np.asarray(v) for v in vals)
 
     def host_send_grads(*gs):
-        communicator.send_obj([np.asarray(g) for g in gs], source_process,
+        arrs = [np.asarray(g) for g in gs]
+        if fr is not None:
+            fr.record("p2p_send_grads", peer=source_process, tag=tag,
+                      nbytes=sum(a.nbytes for a in arrs))
+        communicator.send_obj(arrs, source_process,
                               tag=_GRAD_TAG_OFFSET + tag)
 
     @jax.custom_vjp

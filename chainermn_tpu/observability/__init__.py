@@ -51,26 +51,48 @@ from chainermn_tpu.observability.straggler import (
     straggler_report,
     summarize_durations,
 )
+from chainermn_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    identify_desync,
+    install_flight_recorder,
+    reset_flight_recorder,
+)
+from chainermn_tpu.observability.watchdog import (
+    Watchdog,
+    WatchdogConfig,
+    start_watchdog,
+    watchdog_thread_count,
+)
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "InstrumentedCommunicator",
     "MetricsRegistry",
     "StepTelemetry",
     "StragglerDetector",
+    "Watchdog",
+    "WatchdogConfig",
     "append_jsonl",
     "atomic_write_json",
     "disable",
     "enable",
     "enabled",
+    "get_flight_recorder",
     "get_registry",
+    "identify_desync",
+    "install_flight_recorder",
     "instrument_communicator",
     "prometheus_text",
     "read_jsonl",
+    "reset_flight_recorder",
+    "start_watchdog",
     "straggler_report",
     "summarize_durations",
+    "watchdog_thread_count",
     "write_prometheus",
     "write_snapshot_jsonl",
 ]

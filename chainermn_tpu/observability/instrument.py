@@ -77,9 +77,14 @@ class InstrumentedCommunicator:
                    "allgather_obj", "scatter_obj", "allreduce_obj", "barrier")
 
     def __init__(self, comm, registry: Optional[_registry.MetricsRegistry] = None):
+        from chainermn_tpu.observability import flight_recorder as _flight
+
         self._comm = comm
         self._registry = registry or _registry.get_registry()
         self._comm_label = type(comm).__name__
+        # Flight-recorder seam, bound once here (None when observability
+        # is off — the proxy only exists when enabled or forced anyway).
+        self._flight = _flight.get_flight_recorder()
         r = self._registry
         self._calls = r.counter(
             "comm_collective_calls",
@@ -107,21 +112,38 @@ class InstrumentedCommunicator:
             wire is not None and op in ("allreduce_grad",
                                         "multi_node_mean_grad")
         ) else _leaf_dtype(payload)
+        nbytes = _payload_bytes(payload)
         self._calls.inc(op=op, comm=self._comm_label)
-        self._bytes.inc(_payload_bytes(payload), op=op,
-                        comm=self._comm_label, dtype=dtype)
+        self._bytes.inc(nbytes, op=op, comm=self._comm_label, dtype=dtype)
+        tok = None
+        if self._flight is not None:
+            tok = self._flight.span_begin("collective", op,
+                                          comm=self._comm_label,
+                                          nbytes=nbytes)
         t0 = time.perf_counter()
-        with self._span(op):
-            out = fn()
+        try:
+            with self._span(op):
+                out = fn()
+        finally:
+            if tok is not None:
+                self._flight.span_end(tok)
         self._seconds.observe(time.perf_counter() - t0, op=op,
                               comm=self._comm_label)
         return out
 
     def _run_object(self, op: str, fn):
         self._obj_calls.inc(op=op, comm=self._comm_label)
+        tok = None
+        if self._flight is not None:
+            tok = self._flight.span_begin("object", op,
+                                          comm=self._comm_label)
         t0 = time.perf_counter()
-        with self._span(op):
-            out = fn()
+        try:
+            with self._span(op):
+                out = fn()
+        finally:
+            if tok is not None:
+                self._flight.span_end(tok)
         self._obj_seconds.observe(time.perf_counter() - t0, op=op,
                                   comm=self._comm_label)
         return out

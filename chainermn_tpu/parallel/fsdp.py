@@ -41,7 +41,8 @@ SURVEY.md §7 hard part 5).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+import types
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 
@@ -76,6 +77,57 @@ def _reject_multi_node_wrapper(optimizer):
             "collectives ARE the multi-node integration here")
 
 
+# optax's layer-wise rules all funnel through scale_by_trust_ratio (the
+# LARS/LAMB trust-ratio transform); its qualname survives inside the
+# closure of a chain()'s update function, which is what we walk below.
+_LAYERWISE_QUALNAMES = ("scale_by_trust_ratio", "_scale_by_trust_ratio")
+
+
+def _contains_layerwise_rule(fn, _depth: int = 0, _seen=None) -> bool:
+    """Walk a transformation's update function (and the functions captured
+    in its closure cells — ``optax.chain`` stores its ``update_fns`` tuple
+    there) looking for a trust-ratio rule."""
+    if (not isinstance(fn, types.FunctionType) or _depth > 6
+            or (_seen is not None and id(fn) in _seen)):
+        return False
+    _seen = set() if _seen is None else _seen
+    _seen.add(id(fn))
+    if getattr(fn, "__qualname__", "").startswith(_LAYERWISE_QUALNAMES):
+        return True
+    for cell in fn.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        for x in (v if isinstance(v, (list, tuple)) else [v]):
+            if isinstance(x, types.FunctionType) \
+                    and _contains_layerwise_rule(x, _depth + 1, _seen):
+                return True
+            u = getattr(x, "update", None)
+            if isinstance(u, types.FunctionType) \
+                    and _contains_layerwise_rule(u, _depth + 1, _seen):
+                return True
+    return False
+
+
+def _reject_layerwise_optimizer(optimizer):
+    """LARS/LAMB trust ratios are per-LAYER norms; FSDP's flat per-dtype
+    shards erase leaf boundaries, so the rule would silently compute
+    shard-wise — i.e. wrong — ratios (ADVICE r5).  Detect and refuse;
+    ``fsdp_init(..., allow_layerwise=True)`` is the explicit override for
+    rules we misidentify or users who accept shard-wise semantics."""
+    u = getattr(optimizer, "update", None)
+    if isinstance(u, types.FunctionType) and _contains_layerwise_rule(u):
+        raise ValueError(
+            "optimizer contains a layer-wise trust-ratio rule (optax "
+            "lars/lamb): FSDP flattens parameters into per-dtype shards, "
+            "so trust ratios would be computed over arbitrary shard "
+            "boundaries instead of layers — silently wrong updates. Use "
+            "an element-wise rule (sgd/momentum/adam/adamw/...), or pass "
+            "allow_layerwise=True to fsdp_init if you explicitly want "
+            "shard-wise semantics.")
+
+
 class FsdpMeta(NamedTuple):
     """Static (host-side) layout of the sharded parameter space."""
     pack_meta: Any          # _packing meta: (treedef, dtype keys, leaf order)
@@ -91,16 +143,21 @@ class FsdpState(NamedTuple):
     inner: Any              # inner optax state over the (squeezed) shards
 
 
-def fsdp_init(communicator, params, optimizer):
+def fsdp_init(communicator, params, optimizer, allow_layerwise: bool = False):
     """Shard ``params`` for stage-3 training.
 
     Returns ``(state, meta)``: ``state`` is the :class:`FsdpState` whose
     leaves live sharded on the mesh; ``meta`` is the static layout that
     :func:`make_fsdp_train_step` and :func:`fsdp_full_params` need.
     ``optimizer`` is a plain optax rule (NOT a multi-node wrapper — the
-    collective pattern here IS the multi-node integration).
+    collective pattern here IS the multi-node integration) and must be
+    element-wise: layer-wise trust-ratio rules (optax lars/lamb) are
+    detected and rejected because the flat shards erase layer boundaries;
+    ``allow_layerwise=True`` overrides if you accept shard-wise ratios.
     """
     _reject_multi_node_wrapper(optimizer)
+    if not allow_layerwise:
+        _reject_layerwise_optimizer(optimizer)
     comm = communicator
     size = comm.size
     bufs, pack_meta = _packing.pack(params)
@@ -123,6 +180,39 @@ def fsdp_init(communicator, params, optimizer):
         shards=jax.device_put(stacked, sharding),
         inner=jax.device_put(stacked_inner, sharding),
     ), meta
+
+
+def iter_fsdp_states(tree):
+    """Yield every :class:`FsdpState` inside a python container tree
+    (the checkpoint-state dicts of the examples: ``{"fsdp": state}``).
+    Walks dicts/lists/tuples only — the FsdpState itself is the leaf."""
+    if isinstance(tree, FsdpState):
+        yield tree
+    elif isinstance(tree, dict):
+        for v in tree.values():
+            yield from iter_fsdp_states(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from iter_fsdp_states(v)
+
+
+def fsdp_layout(tree) -> Optional[dict]:
+    """Sharding layout of every FsdpState in ``tree`` (None when there is
+    none): the world size baked into the stacked [size, shard] leaves and
+    the per-state shard lengths.  The multi-node checkpointer persists
+    this next to the arrays so a resume into a different world size or an
+    unsharded state fails loudly instead of restoring garbage."""
+    states = list(iter_fsdp_states(tree))
+    if not states:
+        return None
+    sizes = sorted({int(jnp.shape(s)[0])
+                    for st in states for s in st.shards})
+    return {
+        "world_size": sizes[0] if len(sizes) == 1 else sizes,
+        "shard_lens": [[int(jnp.shape(s)[1]) for s in st.shards]
+                       for st in states],
+        "n_states": len(states),
+    }
 
 
 def fsdp_full_params(state: FsdpState, meta: FsdpMeta):
@@ -309,4 +399,4 @@ def make_fsdp_train_step(
 
 
 __all__ = ["FsdpMeta", "FsdpState", "fsdp_init", "fsdp_full_params",
-           "make_fsdp_train_step"]
+           "fsdp_layout", "iter_fsdp_states", "make_fsdp_train_step"]

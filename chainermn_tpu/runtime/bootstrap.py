@@ -24,7 +24,103 @@ run on any machine.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional
+
+
+def install_crash_dumps(out_dir: Optional[str] = None,
+                        rank: Optional[int] = None,
+                        recorder=None, watchdog=None,
+                        signals=None, force: bool = False):
+    """Wire the flight-recorder dump path to process-fatal events:
+
+    * unhandled exceptions (``sys.excepthook`` — dump, then chain to the
+      previous hook so the traceback still prints);
+    * fatal signals (default: ``SIGTERM``, the preemption/kill signal —
+      dump, restore the prior disposition, re-deliver);
+    * native crashes (``faulthandler.enable`` into
+      ``flight_<rank>.stacks.txt`` — when the interpreter cannot run the
+      JSON dump, the C-level stack writer still can).
+
+    Returns an ``uninstall()`` callable, or ``None`` (installing nothing)
+    when observability is disabled and ``force`` is not set.  When a
+    ``watchdog`` handle is passed, dumps go through its cross-rank state
+    exchange; otherwise the dump is local-only.
+    """
+    import faulthandler
+    import signal as _signal
+
+    from chainermn_tpu.observability import flight_recorder as _flight
+
+    rec = recorder if recorder is not None else _flight.get_flight_recorder()
+    if rec is None:
+        if not force:
+            return None
+        rec = _flight.install_flight_recorder()
+    if out_dir is None:
+        out_dir = os.environ.get("CHAINERMN_TPU_FLIGHT_DIR", ".")
+    if rank is None:
+        rank = int(os.environ.get("CHAINERMN_TPU_PROCESS_ID", "0") or 0)
+
+    def _dump(reason: str) -> None:
+        try:
+            if watchdog is not None:
+                watchdog.dump_now(reason)
+            else:
+                rec.dump(out_dir=out_dir, rank=rank, reason=reason)
+        except Exception:
+            pass  # the dump path must never mask the original failure
+
+    prev_hook = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        _dump(f"unhandled_exception:{tp.__name__}: {val}")
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = _excepthook
+
+    fh_file = None
+    try:
+        os.makedirs(out_dir or ".", exist_ok=True)
+        fh_file = open(os.path.join(out_dir or ".",
+                                    f"flight_{rank}.stacks.txt"), "w")
+        faulthandler.enable(file=fh_file)
+    except OSError:
+        fh_file = None
+
+    prev_handlers = {}
+    sigs = signals if signals is not None else (_signal.SIGTERM,)
+    for sig in sigs:
+        try:
+            prev = _signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                _dump(f"signal:{_signal.Signals(signum).name}")
+                restore = _prev if (callable(_prev) or _prev in (
+                    _signal.SIG_IGN, _signal.SIG_DFL)) else _signal.SIG_DFL
+                _signal.signal(signum, restore)
+                os.kill(os.getpid(), signum)  # re-deliver to prior handler
+
+            _signal.signal(sig, _handler)
+            prev_handlers[sig] = prev
+        except (ValueError, OSError):
+            pass  # not the main thread, or unsupported signal
+
+    def uninstall():
+        sys.excepthook = prev_hook
+        for sig, prev in prev_handlers.items():
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        if fh_file is not None:
+            try:
+                faulthandler.disable()
+                fh_file.close()
+            except (OSError, ValueError):
+                pass
+
+    return uninstall
 
 
 def _tpu_metadata_present() -> bool:
@@ -109,6 +205,7 @@ def init_distributed(
                     f"this host is part of a multi-host slice, fix the "
                     f"bootstrap — training would silently diverge.",
                     RuntimeWarning)
+        install_crash_dumps()   # no-op when observability is disabled
         return
 
     if coordinator is None or num_processes is None or process_id is None:
@@ -132,6 +229,7 @@ def init_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+    install_crash_dumps(rank=process_id)  # no-op when observability is off
 
 
-__all__ = ["init_distributed"]
+__all__ = ["init_distributed", "install_crash_dumps"]

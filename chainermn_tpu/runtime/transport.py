@@ -72,6 +72,10 @@ class PyTransport:
     def __init__(self, rank: int, size: int, coordinator: str):
         self.rank = rank
         self.size = size
+        # Flight-recorder seam, bound once at construction (None when
+        # observability is off — the disabled wire path records nothing).
+        from chainermn_tpu.observability import flight_recorder as _flight
+        self._flight = _flight.get_flight_recorder()
         self._inbox: Dict[Tuple[int, int], queue.Queue] = {}
         self._inbox_lock = threading.Lock()
         # Inbox byte budget (backpressure) — see _DEFAULT_HWM above.
@@ -200,6 +204,9 @@ class PyTransport:
 
     # -- public API ----------------------------------------------------------
     def send(self, dest: int, tag: int, payload: bytes):
+        if self._flight is not None and tag < (1 << 28):
+            self._flight.record("transport_send", dest=dest, tag=tag,
+                                nbytes=len(payload))
         if dest == self.rank:
             self._enqueue(self.rank, tag, payload, wait_budget=False)
             return
@@ -215,12 +222,27 @@ class PyTransport:
             self._write_frame(sock, self.rank, tag, payload)
 
     def recv(self, source: int, tag: int, timeout: float = 300.0) -> bytes:
+        # A wedged recv is the DCN face of a hang: track it as an open
+        # span so the watchdog's deadline predicate sees it.  Watchdog
+        # traffic itself (short-poll recvs on its own tag) stays out of
+        # the ring.
+        fl = self._flight
+        if fl is not None and tag >= (1 << 28):
+            fl = None
+        tok = None
+        if fl is not None:
+            tok = fl.span_begin("transport_recv", f"recv[src={source}]",
+                                tag=tag)
         try:
             payload = self._q(source, tag).get(timeout=timeout)
         except queue.Empty:
+            if tok is not None:
+                fl.span_end(tok, timed_out=True)
             raise TimeoutError(
                 f"recv from rank {source} (tag {tag}) timed out after {timeout}s"
             ) from None
+        if tok is not None:
+            fl.span_end(tok, nbytes=len(payload))
         with self._budget_cv:
             self._inbox_bytes -= len(payload)
             self._budget_cv.notify_all()

@@ -110,7 +110,8 @@ class MetricsReport:
     def __init__(self, trigger=(1, "epoch"), filename: str = "metrics.jsonl",
                  straggler_every: int = 1, straggler_threshold: float = 1.5,
                  prometheus: Optional[str] = None, registry=None,
-                 tokens_per_example: Optional[int] = None):
+                 tokens_per_example: Optional[int] = None,
+                 watchdog: Optional[bool] = None):
         if straggler_every < 1:
             raise ValueError(f"straggler_every must be >= 1, got "
                              f"{straggler_every}")
@@ -121,6 +122,10 @@ class MetricsReport:
         self._prometheus = prometheus
         self._registry = registry
         self._tokens_per_example = tokens_per_example
+        # watchdog=True starts the hang watchdog (flight dumps land next
+        # to the metrics JSONL); None defers to CHAINERMN_TPU_WATCHDOG.
+        self._want_watchdog = watchdog
+        self._watchdog = None
         self._active = False
 
     def initialize(self, trainer):
@@ -144,6 +149,16 @@ class MetricsReport:
                      **{p: 0.0 for p in self._tele.PHASES}}
         self._t_last_emit = time.perf_counter()
         self._emits = 0
+        want_wd = self._want_watchdog
+        if want_wd is None:
+            want_wd = os.environ.get("CHAINERMN_TPU_WATCHDOG", "") \
+                not in ("", "0", "false", "off")
+        if want_wd and self._watchdog is None:
+            from chainermn_tpu.observability import start_watchdog
+
+            self._watchdog = start_watchdog(
+                control_plane=getattr(comm, "_cp", None),
+                out_dir=trainer.out)
 
     def _emit_record(self, trainer) -> dict:
         import time as _t
@@ -212,6 +227,11 @@ class MetricsReport:
     def finalize(self, trainer):
         from chainermn_tpu.observability import append_jsonl, write_snapshot_jsonl
 
+        if self._watchdog is not None:
+            # stop before the run goes quiet — a finished trainer must
+            # not read as a step stall
+            self._watchdog.stop()
+            self._watchdog = None
         if not self._active or self._win["steps"] == 0:
             return
         record = self._emit_record(trainer)

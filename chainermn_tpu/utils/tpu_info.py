@@ -22,11 +22,21 @@ _DEFAULT_PEAK = 197.0  # assume v5e-class when the kind string is unknown
 
 def peak_tflops(device) -> float:
     """bf16 peak of ``device`` (a ``jax.Device``), by device_kind substring."""
+    return peak_tflops_info(device)[0]
+
+
+def peak_tflops_info(device):
+    """``(peak, matched_kind)`` — ``matched_kind`` is the PEAK_TFLOPS key
+    that matched ``device.device_kind``, or ``None`` when the device is
+    unknown and ``peak`` is the assumed v5e-class default.  Benchmarks use
+    the None case to mark their MFU as computed against an ASSUMED peak
+    (``peak_assumed: true`` in the bench JSON) instead of presenting a
+    made-up utilization as fact (ADVICE r5)."""
     kind = getattr(device, "device_kind", "").lower()
     for k, v in PEAK_TFLOPS.items():
         if k in kind:
-            return v
-    return _DEFAULT_PEAK
+            return v, k
+    return _DEFAULT_PEAK, None
 
 
-__all__ = ["PEAK_TFLOPS", "peak_tflops"]
+__all__ = ["PEAK_TFLOPS", "peak_tflops", "peak_tflops_info"]

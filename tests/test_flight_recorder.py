@@ -1,0 +1,395 @@
+"""Flight recorder + collective hang watchdog tests (observability
+tentpole).
+
+Pins the acceptance guarantees: the bounded event ring with per-op
+collective sequence numbers, the cross-rank desync analysis that names
+the rank everyone is waiting for, the WatchdogConfig env round-trip, the
+disabled contract (recorder handle is None, ``start_watchdog`` starts
+ZERO threads, the hot path performs no recording calls), and the live
+watchdog paths — a stalled collective dumps ``flight_<rank>.json``
+within the deadline on a single process, and a two-controller world over
+real sockets leaves a dump on BOTH ranks with the desynchronized rank
+correctly named.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from chainermn_tpu import observability as obs
+from chainermn_tpu.observability import (
+    FlightRecorder,
+    Watchdog,
+    WatchdogConfig,
+    get_flight_recorder,
+    identify_desync,
+    install_flight_recorder,
+    reset_flight_recorder,
+    start_watchdog,
+    watchdog_thread_count,
+)
+from chainermn_tpu.observability.flight_recorder import thread_stacks
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    """Every test starts and ends with no process-wide recorder, the
+    switch off, and no leaked watchdog threads."""
+    reset_flight_recorder()
+    yield
+    reset_flight_recorder()
+    obs.disable()
+    deadline = time.time() + 5
+    while watchdog_thread_count() and time.time() < deadline:
+        time.sleep(0.02)
+    assert watchdog_thread_count() == 0, "test leaked watchdog threads"
+
+
+# ---- the ring ---------------------------------------------------------------
+
+class TestRing:
+    def test_bounded_overwrite_oldest_first(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(12):
+            rec.record("ev", i=i)
+        snap = rec.snapshot()
+        assert len(snap) == 8
+        assert [e["i"] for e in snap] == list(range(4, 12))
+        assert [e["seq"] for e in snap] == list(range(4, 12))
+
+    def test_capacity_env(self, monkeypatch):
+        monkeypatch.setenv("CHAINERMN_TPU_FLIGHT_CAPACITY", "16")
+        assert FlightRecorder().capacity == 16
+        monkeypatch.setenv("CHAINERMN_TPU_FLIGHT_CAPACITY", "bogus")
+        assert FlightRecorder().capacity == 4096
+
+    def test_span_lifecycle(self):
+        rec = FlightRecorder(capacity=32)
+        tok = rec.collective_begin("allreduce", comm="world", nbytes=256)
+        open_ = rec.open_spans()
+        assert len(open_) == 1
+        assert open_[0]["op"] == "allreduce" and open_[0]["op_seq"] == 1
+        assert open_[0]["age_s"] >= 0.0
+        rec.collective_end(tok)
+        assert rec.open_spans() == []
+        st = rec.collective_state()
+        assert st["last_completed"] == {"allreduce": 1}
+        kinds = [e["kind"] for e in rec.snapshot()]
+        assert kinds == ["collective_begin", "collective_end"]
+        end = rec.snapshot()[-1]
+        assert end["dur_s"] >= 0.0 and end["op_seq"] == 1
+
+    def test_per_op_sequence_numbers(self):
+        rec = FlightRecorder(capacity=32)
+        for _ in range(3):
+            rec.collective_end(rec.collective_begin("allreduce"))
+        rec.collective_end(rec.collective_begin("bcast"))
+        st = rec.collective_state()
+        assert st["last_completed"] == {"allreduce": 3, "bcast": 1}
+
+    def test_double_span_end_is_harmless(self):
+        rec = FlightRecorder(capacity=8)
+        tok = rec.span_begin("collective", "barrier")
+        rec.span_end(tok)
+        rec.span_end(tok)  # no double-record, no error
+        assert len(rec.snapshot()) == 2
+
+    def test_step_tracking_and_trailing_median(self):
+        rec = FlightRecorder(capacity=64)
+        assert rec.trailing_step_median() is None
+        for i, d in enumerate((0.1, 0.2, 0.3)):
+            rec.record_step(d, iteration=i)
+        assert rec.steps == 3
+        assert rec.trailing_step_median() == pytest.approx(0.2)
+        assert rec.last_step_end is not None
+
+    def test_dump_writes_parseable_json(self, tmp_path):
+        rec = FlightRecorder(capacity=16)
+        rec.collective_begin("allreduce", comm="world", nbytes=64)
+        path = rec.dump(str(tmp_path), rank=3, reason="unit")
+        assert os.path.basename(path) == "flight_3.json"
+        doc = json.load(open(path))
+        assert doc["kind"] == "flight_dump" and doc["rank"] == 3
+        assert doc["reason"] == "unit"
+        assert doc["collective_state"]["open"][0]["op"] == "allreduce"
+        assert any(t["thread"] == "MainThread" for t in doc["threads"])
+        assert "analysis" not in doc  # no peers -> no cross-rank verdict
+
+    def test_thread_stacks_cover_live_threads(self):
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait, name="stack-probe")
+        t.start()
+        try:
+            stacks = thread_stacks()
+            probe = [s for s in stacks if s["thread"] == "stack-probe"]
+            assert probe and any("wait" in ln for ln in probe[0]["stack"])
+        finally:
+            ev.set()
+            t.join()
+
+
+# ---- desync analysis --------------------------------------------------------
+
+def _state(last_completed, open_=()):
+    return {"last_completed": dict(last_completed),
+            "open": [dict(kind="collective", op=op, op_seq=seq, ts=0.0)
+                     for op, seq in open_],
+            "steps": 0, "event_seq": 0, "ts": 0.0}
+
+
+class TestIdentifyDesync:
+    def test_names_the_rank_behind(self):
+        out = identify_desync({
+            0: _state({"allreduce": 3}, open_=[("allreduce", 4)]),
+            1: _state({"allreduce": 3}),
+        })
+        assert out["desynced_ranks"] == [1]
+        (stall,) = out["stalled_collectives"]
+        assert stall["op"] == "allreduce" and stall["seq"] == 4
+        assert stall["waiting_ranks"] == [0]
+        assert stall["positions"] == {"0": 4, "1": 3}
+
+    def test_all_waiting_no_one_behind(self):
+        out = identify_desync({
+            0: _state({"bcast": 1}, open_=[("bcast", 2)]),
+            1: _state({"bcast": 1}, open_=[("bcast", 2)]),
+        })
+        assert out["desynced_ranks"] == []
+        assert out["stalled_collectives"][0]["waiting_ranks"] == [0, 1]
+
+    def test_local_spans_do_not_flag_peers(self):
+        """transport/p2p spans are local diagnostics, not symmetric ops —
+        a rank blocked in a DCN recv must not mark its peer desynced."""
+        s0 = _state({})
+        s0["open"] = [{"kind": "transport_recv", "op": "recv[src=1]",
+                       "op_seq": 9, "ts": 0.0}]
+        out = identify_desync({0: s0, 1: _state({})})
+        assert out["stalled_collectives"] == []
+        assert out["desynced_ranks"] == []
+
+    def test_no_open_spans(self):
+        out = identify_desync({0: _state({"allreduce": 5}),
+                               1: _state({"allreduce": 5})})
+        assert out == {"stalled_collectives": [], "desynced_ranks": [],
+                       "n_ranks": 2}
+
+
+# ---- config -----------------------------------------------------------------
+
+class TestWatchdogConfig:
+    def test_defaults(self):
+        cfg = WatchdogConfig()
+        assert cfg.deadline_s == 300.0 and cfg.step_stall_factor == 8.0
+        assert cfg.max_dumps == 3 and cfg.out_dir == "."
+
+    def test_from_env_parses_and_falls_back(self):
+        cfg = WatchdogConfig.from_env({
+            "CHAINERMN_TPU_WATCHDOG_DEADLINE": "42.5",
+            "CHAINERMN_TPU_WATCHDOG_MAX_DUMPS": "9",
+            "CHAINERMN_TPU_WATCHDOG_STEP_K": "not-a-number",
+            "CHAINERMN_TPU_FLIGHT_DIR": "/tmp/fl",
+        })
+        assert cfg.deadline_s == 42.5 and cfg.max_dumps == 9
+        assert cfg.step_stall_factor == 8.0  # bad value -> default
+        assert cfg.out_dir == "/tmp/fl"
+
+    def test_env_round_trip(self):
+        cfg = WatchdogConfig.from_env(
+            {}, deadline_s=12.0, heartbeat_interval_s=0.5, out_dir="x")
+        assert WatchdogConfig.from_env(cfg.to_env()) == cfg
+
+    def test_overrides_win(self):
+        cfg = WatchdogConfig.from_env(
+            {"CHAINERMN_TPU_WATCHDOG_DEADLINE": "100"}, deadline_s=7.0)
+        assert cfg.deadline_s == 7.0
+
+
+# ---- disabled contract ------------------------------------------------------
+
+class TestDisabled:
+    def test_recorder_handle_is_none(self):
+        assert not obs.enabled()
+        assert get_flight_recorder() is None
+
+    def test_start_watchdog_is_noop(self, tmp_path):
+        assert start_watchdog(out_dir=str(tmp_path)) is None
+        assert watchdog_thread_count() == 0
+
+    def test_enabled_creates_and_memoizes(self):
+        obs.enable()
+        try:
+            rec = get_flight_recorder()
+            assert isinstance(rec, FlightRecorder)
+            assert get_flight_recorder() is rec
+        finally:
+            obs.disable()
+
+    def test_disabled_hot_path_records_nothing(self, tmp_path, monkeypatch):
+        """Switch off => a full trainer run performs ZERO flight-recorder
+        calls (every recording primitive explodes if touched)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        import chainermn_tpu
+        from chainermn_tpu.datasets import TupleDataset
+        from chainermn_tpu.iterators import SerialIterator
+        from chainermn_tpu.training import StandardUpdater, Trainer
+
+        def boom(*a, **k):
+            raise AssertionError("flight recorder touched while disabled")
+
+        monkeypatch.setattr(FlightRecorder, "record", boom)
+        monkeypatch.setattr(FlightRecorder, "span_begin", boom)
+        monkeypatch.setattr(FlightRecorder, "record_step", boom)
+        monkeypatch.setattr(FlightRecorder, "record_phase", boom)
+
+        comm = chainermn_tpu.create_communicator("naive", intra_size=4)
+        x = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+        it = SerialIterator(TupleDataset(x, np.zeros(32, np.int32)),
+                            batch_size=16, shuffle=False)
+
+        def step(params, opt_state, batch):
+            return params, opt_state, jnp.sum(batch[0])
+
+        updater = StandardUpdater(it, step, {"w": jnp.zeros(2)}, None, comm)
+        trainer = Trainer(updater, (4, "iteration"), out=str(tmp_path))
+        trainer.run()
+        assert trainer.updater.iteration == 4
+        assert watchdog_thread_count() == 0
+
+
+# ---- single-process watchdog ------------------------------------------------
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestWatchdogLocal:
+    def test_stalled_collective_dumps_within_deadline(self, tmp_path):
+        rec = FlightRecorder(capacity=64)
+        cfg = WatchdogConfig(deadline_s=0.2, poll_interval_s=0.05,
+                             out_dir=str(tmp_path))
+        wd = Watchdog(rec, cfg).start()
+        try:
+            rec.collective_begin("allreduce", comm="world", nbytes=1024)
+            assert _wait_for(lambda: wd.dump_paths), \
+                "watchdog never fired on a stalled collective"
+            doc = json.load(open(wd.dump_paths[0]))
+            assert doc["kind"] == "flight_dump"
+            assert doc["reason"].startswith("collective_timeout:allreduce")
+            assert doc["collective_state"]["open"][0]["op"] == "allreduce"
+            assert doc["threads"], "dump must carry thread stacks"
+            assert doc["watchdog"]["deadline_s"] == 0.2
+        finally:
+            wd.stop()
+        assert watchdog_thread_count() == 0
+
+    def test_step_stall_fires_after_quiet_period(self, tmp_path):
+        rec = FlightRecorder(capacity=64)
+        for i in range(6):  # predicate needs >= 5 completed steps
+            rec.record_step(0.001, iteration=i)
+        cfg = WatchdogConfig(deadline_s=60.0, poll_interval_s=0.05,
+                             step_stall_factor=2.0, out_dir=str(tmp_path))
+        wd = Watchdog(rec, cfg).start()
+        try:
+            assert _wait_for(lambda: wd.dump_paths)
+            assert json.load(open(wd.dump_paths[0]))["reason"].startswith(
+                "step_stall")
+        finally:
+            wd.stop()
+
+    def test_max_dumps_bounds_artifacts(self, tmp_path):
+        rec = FlightRecorder(capacity=16)
+        cfg = WatchdogConfig(deadline_s=60.0, poll_interval_s=10.0,
+                             max_dumps=1, out_dir=str(tmp_path))
+        wd = Watchdog(rec, cfg)  # not started: dump_now drives it
+        assert wd.dump_now("first") is not None
+        assert wd.dump_now("second") is None
+        assert len(wd.dump_paths) == 1
+        wd.stop()
+
+    def test_start_watchdog_force_and_stop(self, tmp_path):
+        wd = start_watchdog(force=True, out_dir=str(tmp_path),
+                            deadline_s=30.0, poll_interval_s=0.05)
+        assert wd is not None
+        assert watchdog_thread_count() >= 1
+        assert wd._cfg.out_dir == str(tmp_path)
+        wd.stop()
+        assert _wait_for(lambda: watchdog_thread_count() == 0)
+
+
+# ---- two-controller world over real sockets ---------------------------------
+
+class TestWatchdogWorld:
+    def test_cross_rank_dump_names_desynced_rank(self, tmp_path):
+        """2 controllers over the real DCN transport: both complete
+        allreduce 1..2, rank 0 opens seq 3 and stalls, rank 1 never
+        joins.  Rank 0's watchdog must broadcast, collect rank 1's state,
+        and dump an analysis naming rank 1; rank 1 must dump too
+        (peer_stall), so every controller leaves an artifact."""
+        from chainermn_tpu.runtime.control_plane import SocketControlPlane
+        from chainermn_tpu.runtime.transport import PyTransport
+        from chainermn_tpu.utils.proc_world import free_port
+
+        coord = f"127.0.0.1:{free_port()}"
+        tps = [None, None]
+        errs = []
+
+        def boot(i):
+            try:
+                tps[i] = PyTransport(i, 2, coord)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=boot, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+        assert not errs, errs
+
+        planes = [SocketControlPlane(i, 2, "unused", transport=tps[i])
+                  for i in range(2)]
+        recs = [FlightRecorder(capacity=64) for _ in range(2)]
+        for rec in recs:
+            for _ in range(2):
+                rec.collective_end(
+                    rec.collective_begin("allreduce", comm="world"))
+        cfg = WatchdogConfig(deadline_s=0.4, poll_interval_s=0.05,
+                             collect_window_s=2.0,
+                             heartbeat_interval_s=0.2,
+                             heartbeat_timeout_s=30.0,
+                             out_dir=str(tmp_path))
+        wds = [Watchdog(recs[i], cfg, control_plane=planes[i], rank=i
+                        ).start() for i in range(2)]
+        try:
+            # rank 0 enters allreduce seq 3; rank 1 never does
+            recs[0].collective_begin("allreduce", comm="world")
+            assert _wait_for(lambda: wds[0].dump_paths and wds[1].dump_paths,
+                             timeout=15.0), \
+                (wds[0].dump_paths, wds[1].dump_paths)
+        finally:
+            for wd in wds:
+                wd.stop()
+            for tp in tps:
+                tp.close()
+
+        d0 = json.load(open(os.path.join(str(tmp_path), "flight_0.json")))
+        d1 = json.load(open(os.path.join(str(tmp_path), "flight_1.json")))
+        assert d0["reason"].startswith("collective_timeout:allreduce")
+        assert d1["reason"].startswith("peer_stall:rank0")
+        assert d0["analysis"]["desynced_ranks"] == [1]
+        (stall,) = d0["analysis"]["stalled_collectives"]
+        assert stall["op"] == "allreduce" and stall["seq"] == 3
+        assert stall["positions"] == {"0": 3, "1": 2}
+        # both dumps share the incident id (one hang -> one incident)
+        assert d0["incident"] == d1["incident"]
+        # the merged report names the rank from the dumps alone
+        states = {d["rank"]: d["collective_state"] for d in (d0, d1)}
+        assert identify_desync(states)["desynced_ranks"] == [1]

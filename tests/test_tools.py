@@ -61,6 +61,11 @@ def test_multichip_day1_dry_run():
         assert step in out, f"runbook lost its '{step}' step:\n{out}"
     assert out.count("DRY_RUN: not executed") >= 7, out
     assert "artifact:" in out
+    # the watchdog-knob preflight is hardware-free, so it runs (and must
+    # pass) even under DRY_RUN — a hardware day must not discover that a
+    # CHAINERMN_TPU_WATCHDOG_* env knob stopped round-tripping
+    assert "knobs round-trip OK" in out, out
+    assert "CHAINERMN_TPU_WATCHDOG_DEADLINE" in out, out
 
 
 def test_check_db_overlap_cpu_verdict(tmp_path, devices):
@@ -175,3 +180,38 @@ def test_obs_report_renders_metrics_jsonl(tmp_path):
          str(empty)],
         env=env, capture_output=True, text=True, timeout=120)
     assert r2.returncode == 1
+
+
+def test_obs_report_flight_merges_golden_dumps(tmp_path):
+    """--flight on the checked-in golden hang (tests/data/flight_*.json,
+    a 2-rank world where rank 1 wedged in the input pipeline while rank 0
+    opened allreduce seq 4): the merged report must name the
+    desynchronized rank, highlight the stalled collective in the
+    timeline, and exit 0."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    data = os.path.join(REPO, "tests", "data")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight", data],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "flight dumps (2 rank(s))" in out
+    assert "DESYNCHRONIZED rank(s): 1" in out
+    assert "<< STALLED" in out
+    assert "collective_timeout:allreduce" in out
+    assert "merged timeline" in out
+    # individual files work the same as the directory form
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight", os.path.join(data, "flight_0.json"),
+         os.path.join(data, "flight_1.json")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "DESYNCHRONIZED rank(s): 1" in r2.stdout
+    # no dumps -> loud failure, not an empty report
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r3.returncode == 1

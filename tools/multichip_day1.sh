@@ -38,6 +38,31 @@ run() {  # run <min_devices> <artifact> <desc> -- cmd...
     if "$@"; then echo "   OK"; else echo "   FAILED (continuing — record it)"; fi
 }
 
+# ---- preflight: watchdog/flight-recorder knob round-trip --------------
+# The hang watchdog (docs/observability.md) is the safety net for every
+# multi-chip step below: a wedged collective dumps flight_<rank>.json
+# NEXT TO that step's artifact (CHAINERMN_TPU_FLIGHT_DIR, default the
+# process cwd) — merge them with `tools/obs_report.py --flight <dir>`.
+# The env knobs must survive a from_env/to_env round-trip before a
+# hardware day depends on them; this check is cheap and hardware-free, so
+# it runs even under DRY_RUN.
+echo
+echo "== watchdog env knob round-trip (flight dumps land next to each step's artifact)"
+if $PY_TPU - <<'PYEOF'
+from chainermn_tpu.observability import WatchdogConfig
+
+cfg = WatchdogConfig.from_env({
+    "CHAINERMN_TPU_WATCHDOG_DEADLINE": "120",
+    "CHAINERMN_TPU_WATCHDOG_STEP_K": "6",
+    "CHAINERMN_TPU_FLIGHT_DIR": "hwday_out",
+})
+assert cfg.deadline_s == 120.0 and cfg.step_stall_factor == 6.0, cfg
+again = WatchdogConfig.from_env(cfg.to_env())
+assert again == cfg, (cfg, again)
+print("   knobs round-trip OK: " + " ".join(sorted(cfg.to_env())))
+PYEOF
+then echo "   OK"; else echo "   FAILED (continuing — record it)"; fi
+
 # ---- single-chip steps (run today, re-run on the slice for parity) ----
 
 run 1 "$OUT/TPU_EVIDENCE_$ROUND.json" \

@@ -13,15 +13,25 @@ schema — and prints:
 * straggler section        (latest ``straggler_report`` line);
 * bench results            (``bench`` / ``bench_allreduce`` lines).
 
+``--flight`` switches to hang-dump mode: merge the per-rank
+``flight_<rank>.json`` files a watchdog (or crash handler) wrote into one
+timeline, with the stalled collective highlighted and the desynchronized
+rank named (see docs/observability.md).
+
 Usage::
 
     python tools/obs_report.py result/metrics.jsonl
     python tools/obs_report.py result/metrics.jsonl --section collectives
+    python tools/obs_report.py --flight result/
+    python tools/obs_report.py --flight flight_0.json flight_1.json
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -166,18 +176,187 @@ SECTIONS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# --flight: merge per-rank flight recorder dumps into one timeline
+# ---------------------------------------------------------------------------
+
+def load_flight_dumps(paths: List[str]) -> List[dict]:
+    """Load ``flight_<rank>.json`` dumps.  Each path is either a dump file
+    or a directory to glob for ``flight_*.json``."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "flight_*.json"))))
+        else:
+            files.append(p)
+    dumps = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("kind") != "flight_dump":
+            print(f"warning: {f} is not a flight dump, skipping",
+                  file=sys.stderr)
+            continue
+        doc["_path"] = f
+        dumps.append(doc)
+    dumps.sort(key=lambda d: d.get("rank", 0))
+    return dumps
+
+
+def _flight_analysis(dumps: List[dict]) -> dict:
+    """Cross-rank desync verdict.  Prefer a dump's embedded analysis (the
+    triggering rank computed one over the peer states it collected); fall
+    back to recomputing from the per-rank collective_state sections."""
+    best = None
+    for d in dumps:
+        a = d.get("analysis")
+        if a and a.get("n_ranks", 0) > (best or {}).get("n_ranks", 0):
+            best = a
+    if best is not None and best.get("n_ranks", 0) >= len(dumps):
+        return best
+    states = {d.get("rank", i): d.get("collective_state", {})
+              for i, d in enumerate(dumps)}
+    try:
+        from chainermn_tpu.observability import identify_desync
+        return identify_desync(states)
+    except Exception:  # noqa: BLE001 — report tool must not die on import
+        return best or {"stalled_collectives": [], "desynced_ranks": [],
+                        "n_ranks": len(dumps)}
+
+
+def flight_summary_section(dumps: List[dict]) -> str:
+    rows = []
+    for d in dumps:
+        cs = d.get("collective_state", {})
+        n_open = len(cs.get("open", []))
+        rows.append([
+            str(d.get("rank", "?")),
+            d.get("reason", "-"),
+            str(cs.get("event_seq", "-")),
+            str(n_open),
+            str(len(d.get("threads", []))),
+            d.get("_path", "-"),
+        ])
+    head = f"flight dumps ({len(dumps)} rank(s))"
+    return head + "\n" + _table(
+        ["rank", "reason", "events", "open", "threads", "file"], rows)
+
+
+def flight_desync_section(dumps: List[dict]) -> str:
+    analysis = _flight_analysis(dumps)
+    stalled = analysis.get("stalled_collectives", [])
+    desynced = analysis.get("desynced_ranks", [])
+    lines = []
+    if desynced:
+        lines.append("DESYNCHRONIZED rank(s): "
+                     + ", ".join(str(r) for r in desynced))
+    elif stalled:
+        lines.append("stalled collective(s), no rank behind "
+                     "(all waiting at the same front)")
+    else:
+        lines.append("no stalled collective across the merged dumps")
+    rows = []
+    for s in stalled:
+        pos = s.get("positions", {})
+        rows.append([
+            s.get("op", "?"),
+            str(s.get("seq", "?")),
+            ",".join(str(r) for r in s.get("waiting_ranks", [])) or "-",
+            ",".join(str(r) for r in s.get("desynced_ranks", [])) or "-",
+            " ".join(f"r{r}={p}" for r, p in sorted(
+                pos.items(), key=lambda kv: int(kv[0]))) or "-",
+        ])
+    out = "desync analysis\n" + "\n".join(lines)
+    if rows:
+        out += "\n" + _table(
+            ["op", "seq", "waiting", "desynced", "positions"], rows)
+    return out
+
+
+def flight_timeline_section(dumps: List[dict], max_events: int = 60) -> str:
+    analysis = _flight_analysis(dumps)
+    stalled = {(s.get("op"), s.get("seq"))
+               for s in analysis.get("stalled_collectives", [])}
+    open_keys = set()
+    for d in dumps:
+        for sp in d.get("collective_state", {}).get("open", []):
+            open_keys.add((sp.get("op"), sp.get("op_seq")))
+    merged = []
+    for d in dumps:
+        rank = d.get("rank", "?")
+        for ev in d.get("events", []):
+            merged.append((ev.get("ts", 0.0), rank, ev))
+    merged.sort(key=lambda t: t[0])
+    dropped = max(0, len(merged) - max_events)
+    merged = merged[-max_events:]
+    t0 = merged[0][0] if merged else 0.0
+    rows = []
+    for ts, rank, ev in merged:
+        kind = ev.get("kind", "?")
+        op = ev.get("op", ev.get("phase", ""))
+        op_seq = ev.get("op_seq")
+        detail = " ".join(
+            f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in ev.items()
+            if k not in ("kind", "op", "op_seq", "ts", "seq", "phase")
+            and v is not None)
+        mark = ""
+        key = (op, op_seq)
+        if kind.endswith("_begin") and key in stalled:
+            mark = "<< STALLED"
+        elif kind.endswith("_begin") and key in open_keys:
+            mark = "<< open"
+        rows.append([
+            f"+{ts - t0:.3f}s", f"r{rank}", kind, str(op or "-"),
+            str(op_seq) if op_seq is not None else "-",
+            detail[:60], mark,
+        ])
+    head = "merged timeline"
+    if dropped:
+        head += f" (last {max_events} of {max_events + dropped} events)"
+    if not rows:
+        return head + "\nno events recorded"
+    return head + "\n" + _table(
+        ["t", "rank", "event", "op", "seq", "detail", ""], rows)
+
+
+def flight_report(dumps: List[dict], max_events: int = 60) -> str:
+    return "\n\n".join([
+        flight_summary_section(dumps),
+        flight_desync_section(dumps),
+        flight_timeline_section(dumps, max_events=max_events),
+    ])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("path", help="metrics JSONL file")
+    ap.add_argument("path", nargs="+",
+                    help="metrics JSONL file, or (with --flight) "
+                         "flight_*.json dump files / a directory of them")
     ap.add_argument("--section", choices=sorted(SECTIONS),
                     help="print only one section")
+    ap.add_argument("--flight", action="store_true",
+                    help="merge per-rank flight_<rank>.json hang dumps "
+                         "into one timeline")
+    ap.add_argument("--events", type=int, default=60, metavar="N",
+                    help="max merged timeline events to print "
+                         "(--flight mode, default 60)")
     args = ap.parse_args(argv)
+
+    if args.flight:
+        dumps = load_flight_dumps(args.path)
+        if not dumps:
+            print(f"no flight dumps found in {' '.join(args.path)}",
+                  file=sys.stderr)
+            return 1
+        print(flight_report(dumps, max_events=args.events))
+        return 0
 
     from chainermn_tpu.observability import read_jsonl
 
-    records = read_jsonl(args.path)
+    records = read_jsonl(args.path[0])
     if not records:
-        print(f"no records in {args.path}", file=sys.stderr)
+        print(f"no records in {args.path[0]}", file=sys.stderr)
         return 1
     names = [args.section] if args.section else \
         ["steps", "collectives", "straggler", "bench"]
