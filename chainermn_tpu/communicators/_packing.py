@@ -50,12 +50,16 @@ def pack(tree: Any, comm_dtype: Optional[jnp.dtype] = None):
 
 def unpack(buffers: List[jnp.ndarray], meta, scale: Optional[float] = None):
     """Inverse of :func:`pack`; optionally fuses a ``*= scale`` (the
-    reference's 1/size multiply, fused with the cast-back kernel)."""
+    reference's 1/size multiply, fused with the cast-back kernel).
+
+    The scale is applied AFTER the cast back to each leaf's original
+    dtype: with a reduced-precision comm dtype (bf16 wire) a wire-dtype
+    multiply would round the 1/size factor into the wire's mantissa
+    before the full-precision restore — the cast-back must see the raw
+    reduced values and the scaling happen in leaf precision."""
     treedef, keys, order = meta
     if not order:
         return jax.tree.unflatten(treedef, [])
-    if scale is not None:
-        buffers = [b * jnp.asarray(scale, b.dtype) for b in buffers]
     # Compute split points per group.
     offsets = {k: [0] for k in keys}
     sizes: dict = {k: [] for k in keys}
@@ -72,15 +76,39 @@ def unpack(buffers: List[jnp.ndarray], meta, scale: Optional[float] = None):
         piece = pieces_by_group[key][idx].reshape(shape)
         if piece.dtype != dtype:
             piece = piece.astype(dtype)
+        if scale is not None:
+            piece = piece * jnp.asarray(scale, piece.dtype)
         leaves.append(piece)
     return jax.tree.unflatten(treedef, leaves)
 
 
-def pad_to_multiple(buf: jnp.ndarray, m: int) -> Tuple[jnp.ndarray, int]:
+class PadStrip(int):
+    """The pad amount returned by :func:`pad_to_multiple`, doubling as
+    the inverse operation: ``strip(buf)`` slices a flat buffer of the
+    padded length back to the original one.  Subclasses ``int`` so the
+    historical ``buf, pad = pad_to_multiple(...)`` call sites keep their
+    arithmetic/truthiness semantics (``full[:n - pad]``, ``if pad:``)
+    unchanged."""
+
+    def __new__(cls, rem: int, orig_len: int):
+        self = super().__new__(cls, rem)
+        self.orig_len = int(orig_len)
+        return self
+
+    def __call__(self, buf: jnp.ndarray) -> jnp.ndarray:
+        return buf[: self.orig_len]
+
+
+def pad_to_multiple(buf: jnp.ndarray, m: int) -> Tuple[jnp.ndarray, PadStrip]:
     """Pad a flat buffer so its length divides ``m`` (needed by the
-    reduce-scatter leg of the two-dimensional communicator)."""
-    n = buf.shape[0]
+    reduce-scatter leg of the two-dimensional communicator and by the
+    FSDP shard layout).
+
+    Returns ``(padded, strip)``.  ``strip`` makes the inverse contract
+    explicit: ``strip(padded) == buf`` (it is also the pad amount as an
+    ``int``, for callers that track offsets themselves)."""
+    n = int(buf.shape[0])
     rem = (-n) % m
     if rem:
         buf = jnp.concatenate([buf, jnp.zeros((rem,), buf.dtype)])
-    return buf, rem
+    return buf, PadStrip(rem, n)

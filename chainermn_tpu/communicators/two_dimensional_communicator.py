@@ -43,7 +43,7 @@ class TwoDimensionalCommunicator(MeshCommunicator):
         buffers, meta = _packing.pack(grads)
         out = []
         for buf in buffers:
-            buf, pad = _packing.pad_to_multiple(buf, intra_size)
+            buf, strip = _packing.pad_to_multiple(buf, intra_size)
             n = buf.shape[0]
             shard = lax.psum_scatter(buf, intra_axis, tiled=True)   # ICI leg 1
             shard = lax.psum(shard, inter_axes)                     # DCN leg
@@ -53,5 +53,5 @@ class TwoDimensionalCommunicator(MeshCommunicator):
                 jnp.zeros((n,), buf.dtype), shard,
                 me * (n // intra_size), 0)
             full = lax.psum(placed, intra_axis)
-            out.append(full[:n - pad] if pad else full)
+            out.append(strip(full))
         return _packing.unpack(out, meta, scale=1.0 / self.size)

@@ -139,6 +139,17 @@ class _MultiNodeCheckpointer:
                     f"to the world size — restore on a matching world, or "
                     f"export with fsdp_full_params and re-shard with "
                     f"fsdp_init (the cross-size/cross-mode path)")
+            if "num_buckets" in saved \
+                    and saved["num_buckets"] != live["num_buckets"]:
+                raise ValueError(
+                    f"checkpoint {where} was saved with "
+                    f"num_buckets={saved['num_buckets']} but the live "
+                    f"FsdpState was built with "
+                    f"num_buckets={live['num_buckets']}; the bucketed "
+                    f"shard layout is bound to the bucket config — pass "
+                    f"the same num_buckets/bucket_bytes to fsdp_init "
+                    f"before resuming, or export with fsdp_full_params "
+                    f"and re-shard under the new config")
             if saved["shard_lens"] != live["shard_lens"]:
                 raise ValueError(
                     f"checkpoint {where} shard layout "

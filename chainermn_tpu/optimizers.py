@@ -178,10 +178,10 @@ class _Zero1Optimizer:
         g_bufs, meta = _packing.pack(grads)
         p_bufs, _ = _packing.pack(params) if params is not None else (
             [None] * len(g_bufs), None)
-        orig_lens = [g.shape[0] for g in g_bufs]
-        g_shards, p_shards = [], []
+        g_shards, p_shards, strips = [], [], []
         for g, p in zip(g_bufs, p_bufs):
-            g, _ = _packing.pad_to_multiple(g, size)
+            g, strip = _packing.pad_to_multiple(g, size)
+            strips.append(strip)
             orig_dtype = g.dtype
             if wire_dtype is not None and g.dtype != wire_dtype:
                 g = g.astype(wire_dtype)
@@ -203,11 +203,11 @@ class _Zero1Optimizer:
         # gather-back leg; ~2x the bytes of a ring gather on the cheap
         # ICI resource).
         upd_bufs = []
-        for u, n in zip(updates_sh, orig_lens):
+        for u, strip in zip(updates_sh, strips):
             placed = jax.lax.dynamic_update_slice_in_dim(
                 jnp.zeros((u.shape[0] * size,), u.dtype), u,
                 idx * u.shape[0], 0)
-            upd_bufs.append(comm.allreduce(placed, "sum")[:n])
+            upd_bufs.append(strip(comm.allreduce(placed, "sum")))
         return _packing.unpack(upd_bufs, meta), _ZeroState(inner=inner)
 
     def state_partition_spec(self):

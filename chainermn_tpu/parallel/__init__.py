@@ -35,7 +35,13 @@ from chainermn_tpu.parallel.expert import (
     ExpertParallelMLP,
     moe_apply,
 )
+from chainermn_tpu.parallel.buckets import (
+    BucketAssignment,
+    describe_buckets,
+    partition_buckets,
+)
 from chainermn_tpu.parallel.fsdp import (
+    BucketLayout,
     FsdpMeta,
     FsdpState,
     fsdp_full_params,
@@ -44,11 +50,15 @@ from chainermn_tpu.parallel.fsdp import (
 )
 
 __all__ = [
+    "BucketAssignment",
+    "BucketLayout",
     "ColumnParallelDense",
     "ExpertParallelMLP",
     "RowParallelDense",
     "TensorParallelMLP",
+    "describe_buckets",
     "moe_apply",
+    "partition_buckets",
     "DATA_AXES",
     "FsdpMeta",
     "FsdpState",

@@ -1,4 +1,4 @@
-"""ZeRO-3 / FSDP — fully-sharded data parallelism.
+"""ZeRO-3 / FSDP — fully-sharded data parallelism, bucketed.
 
 **Beyond-reference extension** (the reference shards nothing: params,
 grads, and optimizer state are replicated per GPU — SURVEY.md §2.4; this
@@ -10,39 +10,55 @@ too — each device persistently stores 1/size of the flattened parameter
 space plus the inner optimizer state over that shard, and the full
 parameter set exists only transiently inside the train step.
 
-TPU-native design — the whole stage-3 communication pattern is ONE
-explicit collective plus its autodiff transpose:
+TPU-native design — the stage-3 communication pattern is K explicit
+collectives plus their autodiff transposes, where K is the number of
+parameter BUCKETS (`parallel/buckets.py` cuts the pytree into ~N
+size-balanced contiguous buckets along leaf boundaries, deterministic
+across ranks by construction):
 
-* forward: the step ``all_gather``\\ s the flat parameter shards over the
-  data axes and unpacks them into the model pytree (a device-varying,
-  transient full copy — exactly the memory the forward needs anyway);
+* forward: the step ``all_gather``\\ s each bucket's flat shards over the
+  data axes and unpacks them into that bucket's leaves (a
+  device-varying, transient full copy — exactly the memory the forward
+  needs anyway).  With ``num_buckets > 1`` the gathers are ISSUED IN
+  BUCKET ORDER under a prefetch window of depth D
+  (``prefetch``): bucket i's gather is pinned — via an
+  ``optimization_barrier`` whose custom VJP also pins the transpose — to
+  start only after bucket i-1-D's gather completed, so at most D+1
+  gathers are in flight and XLA's latency-hiding scheduler can overlap
+  bucket i+1's ICI traffic with bucket i's MXU compute;
 * backward: differentiating *with respect to the shards* makes JAX
-  transpose the all_gather into a ``reduce_scatter`` of the full
-  gradients — the ZeRO-2/3 gradient path falls out of the chain rule
-  instead of being hand-scheduled (the reference's NCCL world would need
-  explicit bucketed reduce-scatter calls);
-* update: the inner optax rule runs on the local shard only, so its
+  transpose each bucket's all_gather into its own ``reduce_scatter`` of
+  that bucket's gradients — the ZeRO-2/3 gradient path falls out of the
+  chain rule per bucket instead of one giant transpose-derived
+  collective (the reference's NCCL world would need explicit bucketed
+  reduce-scatter calls; here the bucketing IS the schedule);
+* update: the inner optax rule runs on the local shards only, so its
   state (Adam m/v = 2x params) is divided by the world size, and the
-  updated shard feeds the next step's all_gather.
+  updated shards feed the next step's gathers.
 
-Per-step wire cost is all_gather(params) + reduce_scatter(grads)
-≈ one ring allreduce of the parameter bytes, on the cheap ICI resource —
-the same total as plain DP's gradient allreduce — while persistent
-per-device memory drops from (params + grads + state) to
-(params + state)/size + transient full copies.
+``num_buckets=1`` (the default) reproduces the monolithic
+single-collective schedule bit for bit — no barriers are inserted and
+the traced program is unchanged.  Per-step wire cost is unchanged by
+bucketing: all_gather(params) + reduce_scatter(grads) ≈ one ring
+allreduce of the parameter bytes, on the cheap ICI resource; what
+changes is that the pieces can hide behind compute.  The CPU test mesh
+cannot *time* that overlap — `benchmarks/bench_fsdp_overlap.py` pins the
+schedule structurally (K gathers, K scatters, barrier count) and
+`tools/multichip_day1.sh` carries the on-chip measurement leg.
 
-Same caveat as ZeRO-1: the flat per-dtype shards erase leaf boundaries,
+Same caveat as ZeRO-1: the flat per-bucket shards erase leaf boundaries,
 so inner rules whose update depends on per-leaf structure (LARS/LAMB
-trust ratios) get shard-wise — i.e. wrong — semantics; use
-element-wise rules (sgd/momentum/adam/adamw/...).  BatchNorm state stays
-device-local and un-sharded (the reference's local-BN semantics,
-SURVEY.md §7 hard part 5).
+trust ratios) get shard-wise — i.e. wrong — semantics; use element-wise
+rules (sgd/momentum/adam/adamw/...).  BatchNorm state stays device-local
+and un-sharded (the reference's local-BN semantics, SURVEY.md §7 hard
+part 5).
 """
 
 from __future__ import annotations
 
+import time
 import types
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
 
@@ -60,6 +76,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators import _packing
+from chainermn_tpu.parallel import buckets as _buckets
 
 
 def _reject_multi_node_wrapper(optimizer):
@@ -111,7 +128,7 @@ def _contains_layerwise_rule(fn, _depth: int = 0, _seen=None) -> bool:
 
 
 def _reject_layerwise_optimizer(optimizer):
-    """LARS/LAMB trust ratios are per-LAYER norms; FSDP's flat per-dtype
+    """LARS/LAMB trust ratios are per-LAYER norms; FSDP's flat per-bucket
     shards erase leaf boundaries, so the rule would silently compute
     shard-wise — i.e. wrong — ratios (ADVICE r5).  Detect and refuse;
     ``fsdp_init(..., allow_layerwise=True)`` is the explicit override for
@@ -120,7 +137,7 @@ def _reject_layerwise_optimizer(optimizer):
     if isinstance(u, types.FunctionType) and _contains_layerwise_rule(u):
         raise ValueError(
             "optimizer contains a layer-wise trust-ratio rule (optax "
-            "lars/lamb): FSDP flattens parameters into per-dtype shards, "
+            "lars/lamb): FSDP flattens parameters into per-bucket shards, "
             "so trust ratios would be computed over arbitrary shard "
             "boundaries instead of layers — silently wrong updates. Use "
             "an element-wise rule (sgd/momentum/adam/adamw/...), or pass "
@@ -128,51 +145,153 @@ def _reject_layerwise_optimizer(optimizer):
             "shard-wise semantics.")
 
 
-class FsdpMeta(NamedTuple):
-    """Static (host-side) layout of the sharded parameter space."""
-    pack_meta: Any          # _packing meta: (treedef, dtype keys, leaf order)
+# ---- schedule pinning -------------------------------------------------------
+# lax.optimization_barrier has no autodiff rule on the jax versions this
+# rebuild supports; the custom VJP makes the pin differentiable AND
+# mirrors it onto the cotangents, so the backward's per-bucket
+# reduce-scatters inherit the same windowed ordering in reverse.
+
+@jax.custom_vjp
+def _sched_barrier(xs):
+    return lax.optimization_barrier(xs)
+
+
+def _sched_barrier_fwd(xs):
+    return lax.optimization_barrier(xs), None
+
+
+def _sched_barrier_bwd(_, cts):
+    return (lax.optimization_barrier(cts),)
+
+
+_sched_barrier.defvjp(_sched_barrier_fwd, _sched_barrier_bwd)
+
+
+class BucketLayout(NamedTuple):
+    """Static layout of ONE parameter bucket: a contiguous ``[start,
+    stop)`` run of the flattened leaf order, packed into per-dtype flat
+    buffers exactly like the monolithic layout used to be."""
+    start: int              # first leaf index (flatten order, inclusive)
+    stop: int               # last leaf index (exclusive)
+    pack_meta: Any          # _packing meta over this bucket's leaf list
     orig_lens: tuple        # unpadded flat length per dtype buffer
     shard_lens: tuple       # per-device shard length per dtype buffer
+    pads: tuple             # pad appended to each buffer (len = world pad)
+    nbytes: int             # unpadded payload bytes of the bucket
+    wire_dtype: Optional[str] = None  # per-bucket wire override (or None)
+
+
+class FsdpMeta(NamedTuple):
+    """Static (host-side) layout of the bucketed sharded parameter space."""
+    treedef: Any                    # full parameter pytree structure
+    n_leaves: int
+    buckets: tuple                  # tuple[BucketLayout, ...]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def shard_lens(self) -> tuple:
+        """Flat per-buffer shard lengths across buckets (compat view —
+        ``sum(meta.shard_lens) * size`` bounds the padded parameter
+        count exactly as in the monolithic layout)."""
+        return tuple(l for b in self.buckets for l in b.shard_lens)
+
+    @property
+    def orig_lens(self) -> tuple:
+        return tuple(l for b in self.buckets for l in b.orig_lens)
 
 
 class FsdpState(NamedTuple):
-    """Per-device persistent state: stacked [size, shard] leaves, sharded
-    over the communicator's data axes (same layout convention as the
-    ZeRO-1 inner state and the double-buffer pending grads)."""
-    shards: Any             # list of [size, shard_len] param buffers
+    """Per-device persistent state: ``shards`` is a list (one entry per
+    bucket) of lists of stacked [size, shard] leaves, sharded over the
+    communicator's data axes (same layout convention as the ZeRO-1 inner
+    state and the double-buffer pending grads)."""
+    shards: Any             # [bucket][buffer] -> [size, shard_len] params
     inner: Any              # inner optax state over the (squeezed) shards
 
 
-def fsdp_init(communicator, params, optimizer, allow_layerwise: bool = False):
+def _normalize_wire(dtype) -> Optional[jnp.dtype]:
+    if dtype is None:
+        return None
+    wire = jnp.dtype(dtype)
+    if not jnp.issubdtype(wire, jnp.floating):
+        raise ValueError(
+            f"wire_dtype must be a floating dtype, got {wire} — an "
+            f"integer wire would truncate the gathered parameters")
+    return wire
+
+
+def fsdp_init(communicator, params, optimizer,
+              allow_layerwise: bool = False,
+              num_buckets: int = 1,
+              bucket_bytes: Optional[int] = None,
+              bucket_wire_dtypes: Optional[Sequence] = None):
     """Shard ``params`` for stage-3 training.
 
     Returns ``(state, meta)``: ``state`` is the :class:`FsdpState` whose
-    leaves live sharded on the mesh; ``meta`` is the static layout that
-    :func:`make_fsdp_train_step` and :func:`fsdp_full_params` need.
-    ``optimizer`` is a plain optax rule (NOT a multi-node wrapper — the
-    collective pattern here IS the multi-node integration) and must be
-    element-wise: layer-wise trust-ratio rules (optax lars/lamb) are
+    leaves live sharded on the mesh; ``meta`` is the static bucketed
+    layout that :func:`make_fsdp_train_step` and :func:`fsdp_full_params`
+    need.  ``optimizer`` is a plain optax rule (NOT a multi-node wrapper —
+    the collective pattern here IS the multi-node integration) and must
+    be element-wise: layer-wise trust-ratio rules (optax lars/lamb) are
     detected and rejected because the flat shards erase layer boundaries;
     ``allow_layerwise=True`` overrides if you accept shard-wise ratios.
+
+    Bucketing knobs (see ``parallel/buckets.py``):
+
+    * ``num_buckets=K`` — cut the parameter pytree into K size-balanced
+      contiguous buckets; the train step then runs K all-gathers and K
+      reduce-scatters that can overlap with compute.  The default 1 is
+      the monolithic schedule (bit-for-bit the pre-bucketing step).
+    * ``bucket_bytes`` — derive the count from a per-bucket size target
+      instead (``num_buckets`` wins when both are given).
+    * ``bucket_wire_dtypes`` — optional per-bucket wire-dtype override
+      list (entries None fall back to the step's ``wire_dtype``), e.g.
+      keep embedding buckets on a full-precision wire while the
+      transformer-block buckets ride bf16.
     """
     _reject_multi_node_wrapper(optimizer)
     if not allow_layerwise:
         _reject_layerwise_optimizer(optimizer)
     comm = communicator
     size = comm.size
-    bufs, pack_meta = _packing.pack(params)
-    orig_lens, stacked = [], []
-    for b in bufs:
-        orig_lens.append(int(b.shape[0]))
-        b, _ = _packing.pad_to_multiple(b, size)
-        stacked.append(b.reshape(size, -1))
-    meta = FsdpMeta(pack_meta=pack_meta,
-                    orig_lens=tuple(orig_lens),
-                    shard_lens=tuple(int(s.shape[1]) for s in stacked))
+    leaves, treedef = jax.tree.flatten(params)
+    assignments = _buckets.partition_buckets(
+        leaves, num_buckets=num_buckets if bucket_bytes is None or
+        num_buckets != 1 else None, bucket_bytes=bucket_bytes)
+    if bucket_wire_dtypes is not None \
+            and len(bucket_wire_dtypes) != len(assignments):
+        raise ValueError(
+            f"bucket_wire_dtypes has {len(bucket_wire_dtypes)} entries "
+            f"but the partition produced {len(assignments)} buckets")
+    layouts, stacked = [], []
+    for a in assignments:
+        bufs, pack_meta = _packing.pack(list(leaves[a.start:a.stop]))
+        orig_lens, pads, bucket_stacked = [], [], []
+        for b in bufs:
+            orig_lens.append(int(b.shape[0]))
+            b, strip = _packing.pad_to_multiple(b, size)
+            pads.append(int(strip))
+            bucket_stacked.append(b.reshape(size, -1))
+        wire = None
+        if bucket_wire_dtypes is not None \
+                and bucket_wire_dtypes[a.index] is not None:
+            wire = str(_normalize_wire(bucket_wire_dtypes[a.index]))
+        layouts.append(BucketLayout(
+            start=a.start, stop=a.stop, pack_meta=pack_meta,
+            orig_lens=tuple(orig_lens),
+            shard_lens=tuple(int(s.shape[1]) for s in bucket_stacked),
+            pads=tuple(pads), nbytes=a.nbytes, wire_dtype=wire))
+        stacked.append(bucket_stacked)
+    meta = FsdpMeta(treedef=treedef, n_leaves=len(leaves),
+                    buckets=tuple(layouts))
     # inner state over one device's shard shapes (identical zeros on every
     # device at init, so broadcasting the stack is exact)
-    inner = optimizer.init([jnp.zeros((l,), s.dtype)
-                            for l, s in zip(meta.shard_lens, stacked)])
+    inner = optimizer.init([[jnp.zeros((l,), s.dtype)
+                             for l, s in zip(bl.shard_lens, bufs)]
+                            for bl, bufs in zip(meta.buckets, stacked)])
     stacked_inner = jax.tree.map(
         lambda z: jnp.broadcast_to(z, (size,) + z.shape), inner)
     sharding = NamedSharding(comm.mesh, P(comm.data_axes))
@@ -198,31 +317,115 @@ def iter_fsdp_states(tree):
 
 def fsdp_layout(tree) -> Optional[dict]:
     """Sharding layout of every FsdpState in ``tree`` (None when there is
-    none): the world size baked into the stacked [size, shard] leaves and
-    the per-state shard lengths.  The multi-node checkpointer persists
-    this next to the arrays so a resume into a different world size or an
-    unsharded state fails loudly instead of restoring garbage."""
+    none): the world size baked into the stacked [size, shard] leaves,
+    the bucket count, and the per-bucket shard lengths.  The multi-node
+    checkpointer persists this next to the arrays so a resume into a
+    different world size, bucket config, or an unsharded state fails
+    loudly instead of restoring garbage."""
     states = list(iter_fsdp_states(tree))
     if not states:
         return None
-    sizes = sorted({int(jnp.shape(s)[0])
-                    for st in states for s in st.shards})
+    sizes = sorted({int(jnp.shape(b)[0])
+                    for st in states for b in jax.tree.leaves(st.shards)})
+    n_buckets = sorted({len(st.shards) for st in states})
     return {
         "world_size": sizes[0] if len(sizes) == 1 else sizes,
-        "shard_lens": [[int(jnp.shape(s)[1]) for s in st.shards]
-                       for st in states],
+        "num_buckets": n_buckets[0] if len(n_buckets) == 1 else n_buckets,
+        "shard_lens": [[[int(jnp.shape(b)[1]) for b in bucket]
+                        for bucket in st.shards] for st in states],
         "n_states": len(states),
     }
 
 
 def fsdp_full_params(state: FsdpState, meta: FsdpMeta):
-    """Materialize the full (replicated) parameter pytree from the shards —
-    for evaluation, checkpointing, or export.  No collective and no
-    communicator needed: outside the step the stacked [size, shard]
-    leaves ARE the full buffers, just reshaped (XLA resolves the
-    cross-device reads when the result is consumed)."""
-    bufs = [s.reshape(-1)[:n] for s, n in zip(state.shards, meta.orig_lens)]
-    return _packing.unpack(bufs, meta.pack_meta)
+    """Materialize the full (replicated) parameter pytree from the
+    bucketed shards — for evaluation, checkpointing, or export.  No
+    collective and no communicator needed: outside the step the stacked
+    [size, shard] leaves ARE the full buffers, just reshaped (XLA
+    resolves the cross-device reads when the result is consumed)."""
+    leaves = []
+    for bl, bufs in zip(meta.buckets, state.shards):
+        flat = [b.reshape(-1)[:n] for b, n in zip(bufs, bl.orig_lens)]
+        leaves.extend(_packing.unpack(flat, bl.pack_meta))
+    return jax.tree.unflatten(meta.treedef, leaves)
+
+
+# ---- observability ----------------------------------------------------------
+
+class _FsdpObs:
+    """Per-bucket collective observability for the bucketed step.
+
+    Bound ONCE at step-build time (the zero-cost-when-disabled contract:
+    when both the flight recorder and the metrics switch are off,
+    ``make_fsdp_train_step`` inserts no callbacks and returns the bare
+    jitted step).  Device-side ``jax.debug.callback``\\ s — data-dependent
+    on each bucket's gather inputs/outputs — deliver real per-bucket
+    begin/end timestamps as the device reaches them; rank gating keeps
+    one event stream per process.
+
+    The ``fsdp_overlap`` metric family:
+
+    * ``fsdp_overlap_buckets`` / ``fsdp_overlap_prefetch`` (gauges),
+    * ``fsdp_overlap_bytes`` (counter, labels ``leg`` / ``bucket``),
+    * ``fsdp_overlap_seconds`` (histogram, labels ``leg`` / ``bucket``):
+      host-observed latency between a bucket's begin and end callbacks,
+    * ``fsdp_overlap_dispatch_seconds`` (histogram): host latency of the
+      whole step dispatch.
+
+    The scatter legs run inside the autodiff transpose, so their begin
+    edge is approximated by the loss value becoming available (the start
+    of the backward) — per-bucket *end* stamps are exact, which is what
+    the overlap lane in ``tools/obs_report.py --flight`` stagger-plots.
+    """
+
+    def __init__(self, flight, registry, num_buckets: int, prefetch: int):
+        self.flight = flight
+        self.registry = registry
+        self._begin: dict = {}
+        if registry is not None:
+            registry.gauge(
+                "fsdp_overlap_buckets",
+                "bucket count of the bucketed FSDP step").set(num_buckets)
+            registry.gauge(
+                "fsdp_overlap_prefetch",
+                "prefetch depth of the bucketed FSDP step").set(prefetch)
+            self._bytes = registry.counter(
+                "fsdp_overlap_bytes",
+                "wire bytes moved per FSDP collective leg")
+            self._seconds = registry.histogram(
+                "fsdp_overlap_seconds",
+                "host-observed per-bucket collective latency")
+            self._dispatch = registry.histogram(
+                "fsdp_overlap_dispatch_seconds",
+                "host latency of one bucketed FSDP step dispatch")
+
+    def edge(self, leg: str, edge: str, bucket: int, nbytes: int) -> None:
+        """One begin/end edge of a per-bucket collective (called from the
+        jax debug-callback thread on the gated rank only)."""
+        now = time.perf_counter()
+        if self.flight is not None:
+            self.flight.record(f"fsdp_{leg}_{edge}", bucket=bucket,
+                               nbytes=nbytes)
+        if self.registry is not None:
+            key = (leg, bucket)
+            if edge == "begin":
+                self._begin[key] = now
+            else:
+                t0 = self._begin.pop(key, None)
+                if t0 is not None:
+                    self._seconds.observe(now - t0, leg=leg,
+                                          bucket=str(bucket))
+                self._bytes.inc(nbytes, leg=leg, bucket=str(bucket))
+
+    def make_callback(self, leg: str, edge: str, bucket: int, nbytes: int):
+        def cb(rank_idx, _dep):
+            if int(rank_idx) == 0:
+                self.edge(leg, edge, bucket, nbytes)
+        return cb
+
+    def record_dispatch(self, seconds: float) -> None:
+        if self.registry is not None:
+            self._dispatch.observe(seconds)
 
 
 def make_fsdp_train_step(
@@ -238,8 +441,9 @@ def make_fsdp_train_step(
     batch_spec=None,
     global_loss: bool = False,
     check_vma: bool = True,
+    prefetch: int = 1,
 ):
-    """Build the jitted stage-3 SPMD train step.
+    """Build the jitted stage-3 SPMD train step over the bucketed layout.
 
     ``loss_fn(params, batch)`` (or ``loss_fn(params, model_state, batch)``
     with ``with_model_state=True``) sees the full parameter pytree and the
@@ -250,6 +454,16 @@ def make_fsdp_train_step(
     sharded on their leading axis over the data axes; the loss reported is
     the global mean.
 
+    ``prefetch`` (depth D, default 1) governs the bucketed schedule when
+    ``meta.num_buckets > 1``: bucket i's all-gather is pinned to issue
+    only after bucket i-1-D's gather completed, bounding in-flight
+    gathers to D+1 and giving XLA's latency-hiding scheduler a window to
+    overlap bucket i+1's ICI with bucket i's compute.  The pin is an
+    ``optimization_barrier`` with a custom VJP, so the backward's
+    per-bucket reduce-scatters inherit the mirrored window in reverse.
+    With one bucket no barrier is inserted and the step is the
+    monolithic schedule unchanged.
+
     ``wire_dtype`` (e.g. ``"bfloat16"``) casts each float shard to the
     wire dtype before the all_gather and back after — and because the
     backward is the transpose of that chain, the gradient reduce-scatter
@@ -257,8 +471,10 @@ def make_fsdp_train_step(
     (`allreduce_grad_dtype`) applied to stage 3's BOTH collectives:
     half the gather bytes and half the scatter bytes, with the same
     numerics tradeoff (the reduction accumulates in the wire dtype).
-    Master shards and the inner optimizer state stay full precision.
-    Non-float buffers (int params, if any) are never cast.
+    A per-bucket override in ``meta`` (``fsdp_init(...,
+    bucket_wire_dtypes=...)``) wins over this step-wide default.  Master
+    shards and the inner optimizer state stay full precision.  Non-float
+    buffers (int params, if any) are never cast.
 
     ``accum_steps=K`` — gradient accumulation with the same semantics as
     :func:`chainermn_tpu.optimizers.make_train_step`'s: K equal
@@ -297,38 +513,92 @@ def make_fsdp_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if prefetch < 0:
+        raise ValueError(f"prefetch must be >= 0, got {prefetch}")
     _reject_multi_node_wrapper(optimizer)
     comm = communicator
     axes = comm.data_axes
     axis_arg = axes if len(axes) > 1 else axes[0]
     size = comm.size
-    wire = jnp.dtype(wire_dtype) if wire_dtype is not None else None
-    if wire is not None and not jnp.issubdtype(wire, jnp.floating):
-        raise ValueError(
-            f"wire_dtype must be a floating dtype, got {wire} — an "
-            f"integer wire would truncate the gathered parameters")
+    default_wire = _normalize_wire(wire_dtype)
+    bucket_wires = [
+        _normalize_wire(bl.wire_dtype) if bl.wire_dtype is not None
+        else default_wire
+        for bl in meta.buckets]
+    K = len(meta.buckets)
+
+    # Observability is bound at BUILD time: with both switches off the
+    # traced program carries no callbacks and the bare jitted step is
+    # returned (bit-for-bit the unobserved schedule).
+    from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.observability import registry as _registry
+    fr = _flight.get_flight_recorder()
+    reg = _registry.get_registry() if _registry.enabled() else None
+    obs = _FsdpObs(fr, reg, K, prefetch) if (fr or reg) else None
+
+    def _wire_nbytes(i: int) -> int:
+        # the wire moves the PADDED buffers (shard_len * size elements
+        # each); float buffers ride the bucket's wire dtype, f32 assumed
+        # for the rest — a reporting approximation, not an invariant
+        bl = meta.buckets[i]
+        item = bucket_wires[i].itemsize if bucket_wires[i] is not None else 4
+        return sum(sl * size * item for sl in bl.shard_lens)
 
     def step(state, model_state, batch):
-        shards = [jnp.squeeze(s, 0) for s in state.shards]
+        shards = jax.tree.map(lambda a: jnp.squeeze(a, 0), state.shards)
         inner = jax.tree.map(lambda a: jnp.squeeze(a, 0), state.inner)
         if with_model_state:
             model_state = jax.tree.map(
                 lambda a: jnp.squeeze(a, 0), model_state)
+        me = lax.axis_index(axes[0]) if obs is not None else None
 
-        def local_loss(shards_, model_state_, batch_):
-            # all_gather over the data axes; its autodiff transpose IS the
-            # reduce-scatter of the full gradients (sum over devices).
-            # With wire_dtype the cast sits INSIDE the gather chain, so
-            # the transpose reduce-scatters in the wire dtype as well.
+        def gather_bucket(i, bufs):
+            # all_gather over the data axes; its autodiff transpose IS
+            # the reduce-scatter of this bucket's gradients (sum over
+            # devices).  With a wire dtype the cast sits INSIDE the
+            # gather chain, so the transpose reduce-scatters in the wire
+            # dtype as well.
+            bl = meta.buckets[i]
+            wire = bucket_wires[i]
+            if obs is not None and bufs:
+                jax.debug.callback(
+                    obs.make_callback("gather", "begin", i, _wire_nbytes(i)),
+                    me, bufs[0].reshape(-1)[0])
             full = []
-            for s, n in zip(shards_, meta.orig_lens):
+            for s, n in zip(bufs, bl.orig_lens):
                 orig = s.dtype
-                if wire is not None and jnp.issubdtype(orig, jnp.floating) \
+                if wire is not None \
+                        and jnp.issubdtype(orig, jnp.floating) \
                         and orig != wire:
                     s = s.astype(wire)
                 g = lax.all_gather(s, axis_arg, tiled=True)[:n]
                 full.append(g.astype(orig))
-            params = _packing.unpack(full, meta.pack_meta)
+            if obs is not None and full:
+                jax.debug.callback(
+                    obs.make_callback("gather", "end", i, _wire_nbytes(i)),
+                    me, full[0].reshape(-1)[0])
+            return full
+
+        def local_loss(shards_, model_state_, batch_):
+            # Issue the per-bucket gathers in bucket order under the
+            # prefetch window: bucket i may not start gathering until
+            # bucket i-1-prefetch finished (at most prefetch+1 gathers in
+            # flight).  The barrier's custom VJP mirrors the pin onto the
+            # backward, windowing the per-bucket reduce-scatters too.
+            gathered = []
+            leaves = []
+            for i, bufs in enumerate(shards_):
+                if K > 1 and i > prefetch and gathered[i - prefetch - 1]:
+                    anchor = gathered[i - prefetch - 1]
+                    pinned = _sched_barrier(tuple(bufs) + tuple(anchor))
+                    bufs = list(pinned[:len(bufs)])
+                    # the forward consumes the anchor's post-barrier
+                    # values, keeping the pin live in the graph
+                    gathered[i - prefetch - 1] = list(pinned[len(bufs):])
+                gathered.append(gather_bucket(i, bufs))
+            for bl, full in zip(meta.buckets, gathered):
+                leaves.extend(_packing.unpack(full, bl.pack_meta))
+            params = jax.tree.unflatten(meta.treedef, leaves)
             if with_model_state:
                 return loss_fn(params, model_state_, batch_)
             return loss_fn(params, batch_)
@@ -355,19 +625,35 @@ def make_fsdp_train_step(
                 compute, model_state, batch, accum_steps, has_aux)
         else:
             loss, aux, model_state, gshards = compute(model_state, batch)
+        if obs is not None:
+            # the per-bucket reduce-scatters run inside the transpose:
+            # their shared begin edge is the backward starting (the loss
+            # value exists), the per-bucket end edge is that bucket's
+            # gradient shards existing.
+            for i, gb in enumerate(gshards):
+                if not gb:
+                    continue
+                jax.debug.callback(
+                    obs.make_callback("scatter", "begin", i,
+                                      _wire_nbytes(i)), me, loss)
+                jax.debug.callback(
+                    obs.make_callback("scatter", "end", i, _wire_nbytes(i)),
+                    me, gb[0].reshape(-1)[0])
         if not global_loss:
             # transpose delivered the SUM over devices; reference
             # allreduce_grad semantics are the mean.  (With global_loss
             # the loss was already psum-normalized inside loss_fn, so
             # the summed shard grads ARE the global gradient.)
-            gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
+            gshards = jax.tree.map(
+                lambda g: g / jnp.asarray(size, g.dtype), gshards)
         elif _LEGACY_PSUM_TRANSPOSE:
-            gshards = [g / jnp.asarray(size, g.dtype) for g in gshards]
+            gshards = jax.tree.map(
+                lambda g: g / jnp.asarray(size, g.dtype), gshards)
         updates, inner = optimizer.update(gshards, inner, shards)
         shards = optax.apply_updates(shards, updates)
 
         state = FsdpState(
-            shards=[s[None] for s in shards],
+            shards=jax.tree.map(lambda s: s[None], shards),
             inner=jax.tree.map(lambda a: a[None], inner))
         if with_model_state:
             model_state = jax.tree.map(lambda a: a[None], model_state)
@@ -379,8 +665,9 @@ def make_fsdp_train_step(
         keep = (True, with_model_state, True, has_aux)
         return tuple(o for o, k in zip(outs, keep) if k)
 
-    state_spec = FsdpState(shards=[P(axes)] * len(meta.shard_lens),
-                           inner=P(axes))
+    state_spec = FsdpState(
+        shards=[[P(axes)] * len(bl.shard_lens) for bl in meta.buckets],
+        inner=P(axes))
     out_spec_all = (state_spec, P(axes), P(), P())
     keep = (True, with_model_state, True, has_aux)
     out_specs = tuple(s for s, k in zip(out_spec_all, keep) if k)
@@ -395,8 +682,19 @@ def make_fsdp_train_step(
                            in_specs=in_specs, out_specs=out_specs,
                            check_vma=check_vma)
     donate_argnums = ((0, 1) if with_model_state else (0,)) if donate else ()
-    return jax.jit(mapped, donate_argnums=donate_argnums)
+    jitted = jax.jit(mapped, donate_argnums=donate_argnums)
+    if obs is None or obs.registry is None:
+        return jitted
+
+    def step_with_metrics(*args):
+        t0 = time.perf_counter()
+        out = jitted(*args)
+        obs.record_dispatch(time.perf_counter() - t0)
+        return out
+
+    return step_with_metrics
 
 
-__all__ = ["FsdpMeta", "FsdpState", "fsdp_init", "fsdp_full_params",
-           "fsdp_layout", "iter_fsdp_states", "make_fsdp_train_step"]
+__all__ = ["BucketLayout", "FsdpMeta", "FsdpState", "fsdp_init",
+           "fsdp_full_params", "fsdp_layout", "iter_fsdp_states",
+           "make_fsdp_train_step"]

@@ -208,8 +208,10 @@ class FsdpUpdater(StandardUpdater):
     `chainermn_tpu.parallel.fsdp`).
 
     ``step_fn(fsdp_state, batch) -> (fsdp_state, loss[, aux])`` — from
-    :func:`make_fsdp_train_step`.  The :class:`FsdpState` (sharded param
-    + inner-optimizer buffers) rides the ``opt_state`` slot, and
+    :func:`make_fsdp_train_step`.  The :class:`FsdpState` (BUCKETED
+    sharded param + inner-optimizer buffers: one list of flat shards per
+    partitioner bucket, ``fsdp_init(..., num_buckets=K)``) rides the
+    ``opt_state`` slot unchanged whatever the bucket config, and
     ``.params`` becomes a PROPERTY that materializes the full parameter
     pytree on demand (``fsdp_full_params``) — so evaluators and
     checkpoint-state builders written against ``updater.params`` keep

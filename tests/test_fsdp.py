@@ -90,7 +90,7 @@ class TestSharding:
         state, meta = fsdp_init(comm, params, optax.adam(0.01))
         assert sum(meta.shard_lens) * comm.size >= n_params
         assert sum(meta.shard_lens) <= n_params // comm.size + comm.size
-        for leaf in state.shards:
+        for leaf in jax.tree.leaves(state.shards):
             assert leaf.shape[0] == comm.size
             assert not leaf.sharding.is_fully_replicated
         # adam m/v live at shard size too
@@ -198,7 +198,7 @@ class TestLayerwiseOptimizers:
         params = {"w": jnp.zeros((comm.size * 2,), jnp.float32)}
         state, meta = fsdp_init(comm, params, optax.lars(0.1),
                                 allow_layerwise=True)
-        assert state.shards[0].shape[0] == comm.size
+        assert state.shards[0][0].shape[0] == comm.size
 
     def test_plain_optimizers_pass(self, comm):
         params = {"w": jnp.zeros((comm.size * 2,), jnp.float32)}

@@ -705,12 +705,13 @@ for _ in range(4):
     state, loss = step(state, batch)
     losses.append(float(loss))
 # every shard leaf lives sharded across BOTH processes' devices
-n_shards = sum(len(s.sharding.device_set) for s in state.shards)
+shard_leaves = jax.tree.leaves(state.shards)
+n_shards = sum(len(s.sharding.device_set) for s in shard_leaves)
 w_sum = float(sum(jnp.abs(a).sum()
                   for a in jax.tree.leaves(fsdp_full_params(state, meta))))
 print("RESULT " + json.dumps({
     "losses": losses, "rank": comm.host_rank,
-    "devices_per_shard": n_shards / len(state.shards),
+    "devices_per_shard": n_shards / len(shard_leaves),
     "w_sum": w_sum}))
 """
 

@@ -78,6 +78,52 @@ def test_pad_to_multiple_property(seed):
     assert float(jnp.abs(padded[n:]).sum()) == 0.0
 
 
+_PRIMES = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53]
+
+
+@pytest.mark.parametrize("n", _PRIMES)
+@pytest.mark.parametrize("m", [2, 3, 5, 7, 11, 13])
+def test_pad_strip_round_trip_primes(n, m):
+    """The explicit pad/strip contract: ``strip(padded)`` recovers the
+    original buffer for every (prime length, prime multiple) pair —
+    including n < m, n == m, and gcd(n, m) == 1 remainders."""
+    buf = jnp.arange(1, n + 1, dtype=jnp.float32)
+    padded, strip = _packing.pad_to_multiple(buf, m)
+    assert padded.shape[0] % m == 0
+    # strip doubles as the pad amount (int) for offset-tracking callers
+    assert int(strip) == padded.shape[0] - n == (-n) % m
+    out = strip(padded)
+    assert out.shape == buf.shape
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(buf))
+    # strip is stable: applying it to an already-stripped buffer is a
+    # no-op (the slice is bounded by the original length)
+    np.testing.assert_array_equal(np.asarray(strip(out)), np.asarray(buf))
+
+
+def test_unpack_scale_applied_after_cast():
+    """The fused 1/size multiply must run in each leaf's ORIGINAL dtype,
+    not the wire dtype: a bf16-wire multiply rounds the scaled value into
+    8 mantissa bits before the f32 restore.  Compare against f32-exact
+    scaling of the wire values — the unpacked result must match it
+    bit-for-bit."""
+    scale = 1.0 / 3.0
+    vals = np.asarray([1.0, 2.0, 3.141592, 1e-3, 255.0], np.float32)
+    tree = {"w": jnp.asarray(vals)}
+    bufs, meta = _packing.pack(tree, comm_dtype=jnp.bfloat16)
+    assert bufs[0].dtype == jnp.bfloat16
+    out = _packing.unpack(bufs, meta, scale=scale)["w"]
+    assert out.dtype == jnp.float32
+    # exact reference: cast the wire buffer back to f32 FIRST, then scale
+    wire_f32 = np.asarray(bufs[0]).astype(np.float32)
+    expect = wire_f32 * np.float32(scale)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+    # and the wire-dtype-scaled order would differ for some inputs —
+    # i.e. this test distinguishes the two orders
+    wrong = np.asarray(
+        (bufs[0] * jnp.asarray(scale, jnp.bfloat16)).astype(jnp.float32))
+    assert not np.array_equal(wrong, expect)
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_fit_block_always_divides(seed):
     """The default block auto-halves until it divides ANY T >= 1 (prime,

@@ -97,6 +97,12 @@ run 2 "$OUT/DB_OVERLAP_$ROUND.json" \
     "double-buffer combiner/barrier split check on REAL chips (docs/performance.md 'pending hardware validation': two collectives in the TPU schedule, grads AR overlapping fwd)" -- \
     $PY_TPU tools/check_db_overlap.py --out "$OUT/DB_OVERLAP_$ROUND.json"
 
+run 2 "$OUT/FSDP_OVERLAP_$ROUND.json" \
+    "bucketed-FSDP overlap sweep on REAL chips (docs/performance.md 'FSDP overlap knobs': the CPU mesh pins K gathers/K scatters/barriers structurally but cannot time overlap — step_ms vs num_buckets x prefetch ON ICI is the measurement; look for the knee where per-bucket latency stops hiding behind compute)" -- \
+    bash -c "$PY_TPU benchmarks/bench_fsdp_overlap.py --json \
+        --buckets 1,2,4,8 --prefetch 0,1,2 --wire-dtype bfloat16 \
+        > '$OUT/FSDP_OVERLAP_$ROUND.json'"
+
 # ---- full-shape configs on the slice ----------------------------------
 
 run 4 "$OUT/RUN_CONFIGS_$ROUND.json" \

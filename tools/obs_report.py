@@ -320,12 +320,81 @@ def flight_timeline_section(dumps: List[dict], max_events: int = 60) -> str:
         ["t", "rank", "event", "op", "seq", "detail", ""], rows)
 
 
+def _fsdp_spans(dumps: List[dict]) -> List[dict]:
+    """Pair the bucketed-FSDP per-bucket collective events
+    (``fsdp_{gather,scatter}_{begin,end}``, emitted by the train step's
+    device-side callbacks) into spans: one dict per completed
+    begin/end pair with rank, leg, bucket, start/end ts, and bytes."""
+    spans = []
+    open_spans: Dict[tuple, dict] = {}
+    merged = []
+    for d in dumps:
+        rank = d.get("rank", "?")
+        for ev in d.get("events", []):
+            k = ev.get("kind", "")
+            if k.startswith("fsdp_gather_") or k.startswith("fsdp_scatter_"):
+                merged.append((ev.get("ts", 0.0), rank, ev))
+    merged.sort(key=lambda t: t[0])
+    for ts, rank, ev in merged:
+        _, leg, edge = ev["kind"].split("_", 2)
+        key = (rank, leg, ev.get("bucket"))
+        if edge == "begin":
+            open_spans[key] = {"rank": rank, "leg": leg,
+                               "bucket": ev.get("bucket"), "t0": ts,
+                               "nbytes": ev.get("nbytes", 0)}
+        else:
+            sp = open_spans.pop(key, None)
+            if sp is not None:
+                sp["t1"] = ts
+                spans.append(sp)
+    return spans
+
+
+def flight_fsdp_lane_section(dumps: List[dict], width: int = 48) -> str:
+    """Per-bucket FSDP collective lane: one bar row per (leg, bucket)
+    under the step timeline, so overlap between bucket i's gather and
+    bucket i-1's compute window (or its absence) is visible from a
+    single dump.  Empty string when the dump has no fsdp_* events."""
+    spans = _fsdp_spans(dumps)
+    if not spans:
+        return ""
+    t0 = min(s["t0"] for s in spans)
+    t1 = max(s["t1"] for s in spans)
+    dt = max(t1 - t0, 1e-9)
+
+    def bar(a: float, b: float) -> str:
+        i = int((a - t0) / dt * (width - 1))
+        j = max(int((b - t0) / dt * (width - 1)), i)
+        return "." * i + "#" * (j - i + 1) + "." * (width - 1 - j)
+
+    # lanes keyed (leg, bucket); gathers first (issue order), then
+    # scatters (transpose order) — one row per span occurrence
+    order = {"gather": 0, "scatter": 1}
+    spans.sort(key=lambda s: (order.get(s["leg"], 2),
+                              s.get("bucket") or 0, s["t0"]))
+    rows = []
+    for s in spans:
+        rows.append([
+            f"r{s['rank']}",
+            f"{s['leg']} b{s['bucket']}",
+            bar(s["t0"], s["t1"]),
+            _fmt_s(s["t1"] - s["t0"]),
+            _fmt_bytes(s.get("nbytes", 0)),
+        ])
+    head = (f"fsdp per-bucket collectives "
+            f"({len(spans)} span(s), window {dt * 1e3:.3f} ms)")
+    return head + "\n" + _table(
+        ["rank", "lane", "timeline", "dur", "bytes"], rows)
+
+
 def flight_report(dumps: List[dict], max_events: int = 60) -> str:
-    return "\n\n".join([
+    parts = [
         flight_summary_section(dumps),
         flight_desync_section(dumps),
         flight_timeline_section(dumps, max_events=max_events),
-    ])
+        flight_fsdp_lane_section(dumps),
+    ]
+    return "\n\n".join(p for p in parts if p)
 
 
 def main(argv=None) -> int:
