@@ -30,6 +30,14 @@ _EXPORTS = {
     "normalize_image": "chainermn_tpu.datasets",
     # runtime observability (beyond-reference subsystem)
     "instrument_communicator": "chainermn_tpu.observability",
+    # gradient compression wires (beyond-reference subsystem)
+    "Compressor": "chainermn_tpu.compression",
+    "NoCompression": "chainermn_tpu.compression",
+    "Int8Compressor": "chainermn_tpu.compression",
+    "Fp8Compressor": "chainermn_tpu.compression",
+    "CompressionState": "chainermn_tpu.compression",
+    "resolve_compressor": "chainermn_tpu.compression",
+    "available_compressors": "chainermn_tpu.compression",
     "create_multi_node_evaluator": "chainermn_tpu.extensions",
     "AllreducePersistent": "chainermn_tpu.extensions",
     "create_multi_node_checkpointer": "chainermn_tpu.extensions",

@@ -41,12 +41,20 @@ def create_communicator(
     mesh=None,
     allreduce_grad_dtype=None,
     intra_size: Optional[int] = None,
+    compression=None,
     **kwargs,
 ) -> CommunicatorBase:
     """Create a communicator by name (reference signature:
     ``create_communicator(communicator_name, mpi_comm, allreduce_grad_dtype)``;
     the ``mpi_comm`` argument becomes ``mesh`` — topology is discovered from
     the device list when omitted, no launcher in the loop).
+
+    ``compression`` selects the gradient wire codec (name, instance, or
+    config dict — see :mod:`chainermn_tpu.compression`).  A
+    ``NoCompression(wire_dtype=...)`` is exactly the legacy
+    ``allreduce_grad_dtype`` knob (same 'xla'-only restriction); the
+    quantizers (``"int8"``, ``"fp8"``) work with every flavor because
+    they ride the generic pack/psum path.
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -54,13 +62,20 @@ def create_communicator(
         raise ValueError(
             f"unknown communicator {communicator_name!r}; available: "
             f"{sorted(_COMMUNICATORS)}") from None
-    if allreduce_grad_dtype is not None and not cls.supports_allreduce_grad_dtype:
+    from chainermn_tpu.compression import NoCompression, resolve_compressor
+    compression = resolve_compressor(compression)
+    wire_knob = allreduce_grad_dtype is not None or (
+        isinstance(compression, NoCompression)
+        and compression.wire is not None)
+    if wire_knob and not cls.supports_allreduce_grad_dtype:
         # Parity with the reference factory's restriction.
         raise ValueError(
-            "allreduce_grad_dtype is only supported by the 'xla'/'pure_nccl' "
-            "communicator")
+            "allreduce_grad_dtype (= compression=NoCompression(wire_dtype)) "
+            "is only supported by the 'xla'/'pure_nccl' communicator")
     if allreduce_grad_dtype is not None:
         kwargs["allreduce_grad_dtype"] = allreduce_grad_dtype
+    if compression is not None:
+        kwargs["compression"] = compression
     return cls(mesh=mesh, intra_size=intra_size, **kwargs)
 
 

@@ -123,7 +123,7 @@ class CommunicatorBase(abc.ABC):
 
     # ---- gradient entry points (the hot path) ------------------------------
     @abc.abstractmethod
-    def allreduce_grad(self, grads): ...
+    def allreduce_grad(self, grads, *, compressor=None, state=None): ...
 
     @abc.abstractmethod
     def bcast_data(self, params): ...

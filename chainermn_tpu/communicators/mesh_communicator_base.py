@@ -78,6 +78,7 @@ class MeshCommunicator(CommunicatorBase):
         allreduce_grad_dtype=None,
         control_plane: Optional[cp_mod.ControlPlane] = None,
         intra_size: Optional[int] = None,
+        compression=None,
     ):
         if topology is None:
             topology = (topo_mod.topology_from_mesh(mesh) if mesh is not None
@@ -88,6 +89,24 @@ class MeshCommunicator(CommunicatorBase):
         for ax in self._data_axes:
             if ax not in self._mesh.shape:
                 raise ValueError(f"axis {ax!r} not in mesh {self._mesh.axis_names}")
+        # ``compression`` subsumes the legacy dtype knob: a NoCompression
+        # wire folds INTO allreduce_grad_dtype (so every downstream reader
+        # of the attribute — ZeRO-1, packing — behaves identically), while
+        # quantizing codecs ride their own collective path in
+        # allreduce_grad.
+        from chainermn_tpu.compression import NoCompression, \
+            resolve_compressor
+        self.compression = resolve_compressor(compression)
+        if isinstance(self.compression, NoCompression) \
+                and self.compression.wire is not None:
+            if allreduce_grad_dtype is not None and \
+                    jnp.dtype(allreduce_grad_dtype) != self.compression.wire:
+                raise ValueError(
+                    f"conflicting wire dtypes: allreduce_grad_dtype="
+                    f"{allreduce_grad_dtype} vs compression="
+                    f"{self.compression!r} — pass only "
+                    f"compression=NoCompression(wire_dtype=...)")
+            allreduce_grad_dtype = self.compression.wire
         if allreduce_grad_dtype is not None and not self.supports_allreduce_grad_dtype:
             # Parity with the reference: only pure_nccl accepts the dtype knob.
             raise ValueError(
@@ -377,7 +396,7 @@ class MeshCommunicator(CommunicatorBase):
         return jax.tree.map(lambda v: lax.ppermute(v, axis, perm), x)
 
     # ---- gradient entry points ---------------------------------------------
-    def allreduce_grad(self, grads):
+    def allreduce_grad(self, grads, *, compressor=None, state=None):
         """Average gradients across the data-parallel world.
 
         Reference: ``Communicator.allreduce_grad(model)``
@@ -390,16 +409,120 @@ class MeshCommunicator(CommunicatorBase):
           the collective during backward); only the communication-dtype
           roundtrip remains observable, and it is applied for numerical
           parity with the reference's cast-allreduce-cast path.
+
+        ``compressor`` selects the wire codec for THIS call (default: the
+        communicator's ``compression=`` / ``allreduce_grad_dtype`` config):
+
+        * ``None`` / ``NoCompression()`` — the paths above, unchanged;
+        * ``NoCompression(wire_dtype=...)`` — pack-cast-psum-unpack,
+          bit-for-bit the ``allreduce_grad_dtype`` program;
+        * a quantizer (``"int8"`` / ``"fp8"``) — stateful EF compression:
+          pass ``state`` (a :class:`~chainermn_tpu.compression.\
+CompressionState` from :meth:`init_compression_state`) and the call
+          returns ``(mean_grads, new_state)`` instead of just grads.
         """
+        from chainermn_tpu.compression import base as _cbase
+        from chainermn_tpu.compression import quantize as _cq
+        comp = (_cbase.resolve_compressor(compressor)
+                if compressor is not None else
+                (self.compression if _cq.is_quantizing(self.compression)
+                 else None))
+        if _cq.is_quantizing(comp):
+            if state is None:
+                raise ValueError(
+                    f"compressor {comp.name!r} keeps error-feedback state: "
+                    "pass state=comm.init_compression_state(grads, "
+                    "compressor) and thread the returned new state into "
+                    "the next call")
+            return self._allreduce_grad_compressed(grads, comp, state)
+        wire = comp.wire if comp is not None else None
         if self.in_spmd_context():
+            if wire is not None:
+                return self._allreduce_grad_wire(grads, wire)
             return self._allreduce_grad_traced(grads)
-        if self.allreduce_grad_dtype is None:
+        dt = wire if wire is not None else self.allreduce_grad_dtype
+        if dt is None:
             return grads
-        dt = self.allreduce_grad_dtype
         return jax.tree.map(lambda g: g.astype(dt).astype(g.dtype), grads)
 
     # Upstream ChainerMN later renamed this; keep both spellings.
     multi_node_mean_grad = allreduce_grad
+
+    def init_compression_state(self, tree, compressor=None):
+        """Fresh error-feedback state for quantized :meth:`allreduce_grad`
+        over ``tree``-shaped gradients (``None`` for stateless codecs).
+        Sized for the single packed float32 buffer the compressed path
+        exchanges."""
+        from chainermn_tpu.compression import base as _cbase
+        from chainermn_tpu.compression import quantize as _cq
+        comp = (_cbase.resolve_compressor(compressor)
+                if compressor is not None else self.compression)
+        if not _cq.is_quantizing(comp):
+            return None
+        n = sum(int(np.prod(jnp.shape(l))) for l in jax.tree.leaves(tree))
+        return comp.init_state(n, self.size)
+
+    def _allreduce_grad_wire(self, grads, wire):
+        """NoCompression(wire_dtype): the exact cast-allreduce-cast
+        program of the ``allreduce_grad_dtype`` knob (xla communicator's
+        non-pallas lowering) — one packed buffer in the wire dtype, one
+        psum, unpack with the 1/size mean folded in."""
+        from chainermn_tpu.communicators import _packing
+        buffers, meta = _packing.pack(grads, comm_dtype=wire)
+        ax = self._axis_arg()
+        buffers = [lax.psum(b, ax) for b in buffers]
+        return _packing.unpack(buffers, meta, scale=1.0 / self.size)
+
+    def _allreduce_grad_compressed(self, grads, comp, state):
+        """Quantized exchange: pack to one f32 buffer, EF-encode to wire
+        codes, SUM the codes in wire arithmetic, decode + delayed-scale
+        update, mean, unpack.  Returns ``(mean_grads, new_state)``."""
+        from chainermn_tpu.communicators import _packing
+        from chainermn_tpu.compression import observe as _cobs
+        from chainermn_tpu.compression import quantize as _cq
+        traced = self.in_spmd_context()
+        n = self.size if traced else 1
+        buffers, meta = _packing.pack(grads, comm_dtype=jnp.float32)
+        buf = buffers[0]
+        m = int(buf.shape[0])
+        if int(state.ef.shape[0]) != comp._padded(m):
+            raise ValueError(
+                f"compression state sized for ef={state.ef.shape[0]} "
+                f"does not match this gradient tree (needs "
+                f"{comp._padded(m)}): build it with "
+                "comm.init_compression_state(grads, compressor)")
+        obs = _cobs.get_compression_obs() if traced else None
+        rank = self.axis_index() if traced else None
+        if obs is not None:
+            bpp = _cq.wire_bits_per_param(comp, m, n)
+            saved = (m * 4 - (comp._padded(m) + comp.n_chunks(m))
+                     * jnp.dtype(comp.wire).itemsize)
+            jax.debug.callback(
+                obs.make_callback("compress", "begin", "allreduce", 0,
+                                  comp.name, bpp, saved),
+                rank, 0.0, buf[0])
+        codes, state = comp.compress(buf, state, rank=rank, world_size=n)
+        if obs is not None:
+            rnorm = jnp.sqrt(jnp.sum(jnp.square(state.ef)))
+            jax.debug.callback(
+                obs.make_callback("compress", "end", "allreduce", 0,
+                                  comp.name, bpp, saved),
+                rank, rnorm, codes[0])
+        summed = lax.psum(codes, self._axis_arg()) if traced else codes
+        if obs is not None:
+            jax.debug.callback(
+                obs.make_callback("decompress", "begin", "allreduce", 0,
+                                  comp.name, bpp, saved),
+                rank, 0.0, summed[0])
+        out, state = comp.decompress(summed, state, world_size=n)
+        if obs is not None:
+            jax.debug.callback(
+                obs.make_callback("decompress", "end", "allreduce", 0,
+                                  comp.name, bpp, saved),
+                rank, 0.0, out[0])
+        out = out[:m]
+        scale = (1.0 / n) if traced else None
+        return _packing.unpack([out], meta, scale=scale), state
 
     def _allreduce_grad_traced(self, grads):
         """Default decomposition (naive): per-leaf psum over all data axes.
@@ -456,13 +579,19 @@ class MeshCommunicator(CommunicatorBase):
         on the sub-world; otherwise falls back to the generic per-leaf psum
         communicator.
         """
+        from chainermn_tpu.compression import quantize as _cq
         kwargs = {}
         if self.supports_allreduce_grad_dtype and self.allreduce_grad_dtype is not None:
             kwargs["allreduce_grad_dtype"] = self.allreduce_grad_dtype
+        if _cq.is_quantizing(self.compression):
+            # Quantizers are flavor-independent (they ride pack/psum), so
+            # they survive any sub-world — unlike the dtype knob above.
+            kwargs["compression"] = self.compression
         try:
             return type(self)(topology=self._topology, data_axes=tuple(axes),
                               control_plane=self._cp, **kwargs)
         except ValueError:
             # e.g. hierarchical/two_dimensional need >= 2 axes
             return MeshCommunicator(topology=self._topology, data_axes=tuple(axes),
-                                    control_plane=self._cp)
+                                    control_plane=self._cp,
+                                    compression=kwargs.get("compression"))

@@ -22,12 +22,26 @@ from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
 
 
 class NonCudaAwareCommunicator(FlatCommunicator):
-    def allreduce_grad(self, grads):
-        if self.in_spmd_context():
-            # No host exists inside an XLA program; use the flat decomposition.
-            return self._allreduce_grad_traced(grads)
+    def allreduce_grad(self, grads, *, compressor=None, state=None):
+        from chainermn_tpu.compression import base as _cbase
+        from chainermn_tpu.compression import quantize as _cq
+        comp = (_cbase.resolve_compressor(compressor)
+                if compressor is not None else
+                (self.compression if _cq.is_quantizing(self.compression)
+                 else None))
+        if _cq.is_quantizing(comp) or self.in_spmd_context():
+            # No host exists inside an XLA program, and quantizing codecs
+            # ride the in-wire-summing collective either way; use the flat
+            # decomposition (codec handling included).
+            return super().allreduce_grad(
+                grads, compressor=compressor, state=state)
         # Eager: device -> host -> (DCN mean across hosts) -> device, the
         # staged path the reference implements with pinned buffers.
+        if comp is not None and comp.wire is not None:
+            # Honor an explicit lossless wire codec with the same
+            # cast-roundtrip the in-program path observes.
+            grads = jax.tree.map(
+                lambda g: g.astype(comp.wire).astype(g.dtype), grads)
         host = jax.device_get(grads)
         if self.host_size > 1:
             summed = self.allreduce_obj(host, op="sum")

@@ -1,0 +1,182 @@
+"""Compressor protocol + registry — the pluggable gradient-wire codecs.
+
+**Beyond-reference extension** (labeled like the other `parallel/`
+extensions).  The anaruse fork's signature trick was a reduced-precision
+gradient wire (`allreduce_grad_dtype='float16'`): cast in, allreduce in
+the wire dtype, cast back.  This package generalizes that cast into a
+``Compressor`` protocol so the same three exchange seams —
+``allreduce_grad``, ``create_multi_node_optimizer``, and the bucketed
+FSDP reduce-scatter — can ride anything from a plain dtype cast
+(:class:`NoCompression`, which lowers to the exact current program) to
+int8/fp8 quantization with error feedback (``quantize.py`` /
+``error_feedback.py``), the DynamiQ/FlexLink recipe.
+
+A compressor is identified by its **spec** — a canonical JSON string of
+its name + config — which is what bucket layouts, checkpoints sidecars,
+and the resume guard compare.  Construction routes through
+:func:`resolve_compressor`, which accepts a registry name (``"int8"``),
+a spec string/dict, or an instance.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Type
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Protocol for a gradient wire codec.
+
+    ``compress(buf, state) -> (wire, state)`` encodes one flat float
+    buffer into its wire representation; ``decompress(wire, state) ->
+    (buf, state)`` decodes the *summed* wire buffer back (the collective
+    between the two SUMS wire values in wire arithmetic, so codecs must
+    be closed under summation — int8 codes clip to ``max_code //
+    world_size`` for exactly this reason).  Stateful codecs carry an
+    :class:`~chainermn_tpu.compression.error_feedback.CompressionState`
+    (EF residual + delayed scales + step counter) through both calls.
+
+    Identity/config:
+
+    * ``name`` — registry key;
+    * ``config()`` — JSON-serializable kwargs that reconstruct it;
+    * ``spec`` — the canonical JSON identity string (checkpoint guard).
+    """
+
+    name: str = "?"
+    stateful: bool = False
+
+    # -- identity ------------------------------------------------------------
+    def config(self) -> Dict[str, Any]:
+        return {}
+
+    @property
+    def spec(self) -> str:
+        return json.dumps({"name": self.name, **self.config()},
+                          sort_keys=True)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.config()})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Compressor) and self.spec == other.spec
+
+    def __hash__(self) -> int:
+        return hash(self.spec)
+
+    # -- wire ----------------------------------------------------------------
+    def wire_dtype_for(self, dtype) -> jnp.dtype:
+        """Dtype the collective runs in for a buffer of ``dtype``."""
+        return jnp.dtype(dtype)
+
+    def compress(self, buf, state=None, rank=None):
+        raise NotImplementedError
+
+    def decompress(self, wire, state=None):
+        raise NotImplementedError
+
+
+class NoCompression(Compressor):
+    """The identity codec — today's wire-dtype cast, as a Compressor.
+
+    ``NoCompression(wire_dtype="bfloat16")`` IS ``allreduce_grad_dtype=
+    "bfloat16"``: every seam detects it and lowers to the exact program
+    the bare dtype knob produced (pack -> cast -> collective in the wire
+    dtype -> cast back -> scale), bit for bit.  ``NoCompression()`` with
+    no wire dtype is the do-nothing default.
+    """
+
+    name = "none"
+    stateful = False
+
+    def __init__(self, wire_dtype=None):
+        if wire_dtype is not None:
+            wire = jnp.dtype(wire_dtype)
+            if not jnp.issubdtype(wire, jnp.floating):
+                raise ValueError(
+                    f"NoCompression wire_dtype must be floating, got "
+                    f"{wire} — integer wires need a quantizer ('int8')")
+            wire_dtype = str(wire)
+        self.wire_dtype = wire_dtype
+
+    def config(self):
+        return {"wire_dtype": self.wire_dtype}
+
+    @property
+    def wire(self) -> Optional[jnp.dtype]:
+        return jnp.dtype(self.wire_dtype) if self.wire_dtype else None
+
+    def wire_dtype_for(self, dtype):
+        if self.wire_dtype is not None \
+                and jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return jnp.dtype(self.wire_dtype)
+        return jnp.dtype(dtype)
+
+    def compress(self, buf, state=None, rank=None):
+        return buf.astype(self.wire_dtype_for(buf.dtype)), state
+
+    def decompress(self, wire, state=None):
+        return wire, state
+
+
+# ---- registry ---------------------------------------------------------------
+
+_REGISTRY: Dict[str, Type[Compressor]] = {}
+
+
+def register_compressor(name: str, cls: Type[Compressor]) -> None:
+    _REGISTRY[name] = cls
+
+
+def available_compressors():
+    return sorted(_REGISTRY)
+
+
+register_compressor(NoCompression.name, NoCompression)
+
+
+def resolve_compressor(value) -> Optional[Compressor]:
+    """Turn any accepted compression designation into a Compressor.
+
+    Accepts ``None`` (no compression), a :class:`Compressor` instance, a
+    registry name (``"int8"``), a plain wire dtype string
+    (``"bfloat16"`` -> ``NoCompression(wire_dtype=...)``), a spec JSON
+    string, or a config dict (``{"name": "int8", "chunk_size": 512}``).
+    """
+    if value is None or isinstance(value, Compressor):
+        return value
+    cfg = None
+    if isinstance(value, dict):
+        cfg = dict(value)
+    elif isinstance(value, str):
+        s = value.strip()
+        if s.startswith("{"):
+            cfg = json.loads(s)
+        elif s in _REGISTRY:
+            cfg = {"name": s}
+        else:
+            # a bare dtype string is the legacy wire knob's spelling
+            try:
+                jnp.dtype(s)
+            except TypeError:
+                raise ValueError(
+                    f"unknown compressor {value!r}; available: "
+                    f"{available_compressors()} (or a wire dtype like "
+                    f"'bfloat16', or a spec dict/JSON)") from None
+            cfg = {"name": "none", "wire_dtype": s}
+    else:
+        raise TypeError(
+            f"cannot resolve a compressor from {type(value).__name__}; "
+            f"pass a name, spec dict/JSON, dtype string, or Compressor")
+    name = cfg.pop("name", None)
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown compressor {name!r}; available: "
+            f"{available_compressors()}")
+    return _REGISTRY[name](**cfg)
+
+
+__all__ = ["Compressor", "NoCompression", "available_compressors",
+           "register_compressor", "resolve_compressor"]

@@ -1,0 +1,115 @@
+"""Error-feedback state — the residual memory that makes lossy wires safe.
+
+Error feedback (EF / EF-SGD): each rank adds the compression error it
+committed last step back into this step's gradient before compressing
+again, so quantization error ACCUMULATES into the update stream instead
+of being lost — the property that makes 1-byte wires converge like
+full-precision ones on smooth objectives.
+
+:class:`CompressionState` is the per-buffer carrier:
+
+* ``ef`` — this rank's residual, full buffer length (device-varying:
+  every rank keeps its own error);
+* ``scale`` — the delayed quantization scale state, stored as base-2
+  EXPONENTS (``scale = 2**e``).  Power-of-two scales are exactly
+  representable in every float wire dtype, which is what lets the FSDP
+  seam piggyback scale redistribution on the parameter all-gather
+  without a dedicated collective;
+* ``step`` — a float32 step counter seeding the stochastic-rounding
+  PRNG stream (float so the whole state is a valid cotangent: the FSDP
+  seam threads EF state through the backward as a custom-VJP cotangent).
+
+The state is a registered pytree whose *static* aux data carries the
+compressor spec and an ``EF_VERSION``, so checkpoints persist the
+config alongside the arrays and the resume guard can refuse a
+mismatched compressor with an actionable error (mirroring the FSDP
+``num_buckets`` guard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+EF_VERSION = 1
+
+
+@jax.tree_util.register_pytree_node_class
+class CompressionState:
+    """Per-buffer EF + delayed-scale state (see module docstring).
+
+    Children (arrays): ``ef``, ``scale`` (base-2 exponents), ``step``.
+    Static aux: ``spec`` (the compressor's canonical JSON identity) and
+    ``ef_version`` — both ride the treedef, so two states with different
+    compressor configs are *structurally* different pytrees.
+    """
+
+    def __init__(self, ef, scale, step, spec: str = "",
+                 ef_version: int = EF_VERSION):
+        self.ef = ef
+        self.scale = scale
+        self.step = step
+        self.spec = spec
+        self.ef_version = ef_version
+
+    def tree_flatten(self):
+        return (self.ef, self.scale, self.step), (self.spec,
+                                                  self.ef_version)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        ef, scale, step = children
+        return cls(ef, scale, step, spec=aux[0], ef_version=aux[1])
+
+    def _replace(self, **kw):
+        d = {"ef": self.ef, "scale": self.scale, "step": self.step,
+             "spec": self.spec, "ef_version": self.ef_version}
+        d.update(kw)
+        return CompressionState(**d)
+
+    def __repr__(self):
+        return (f"CompressionState(ef={jnp.shape(self.ef)}, "
+                f"scale={jnp.shape(self.scale)}, spec={self.spec})")
+
+
+def init_state(compressor, length: int, n_scales: int) -> CompressionState:
+    """Fresh single-rank EF state for one flat buffer: zero residual,
+    unit scales (``e=0`` -> ``2**0``; the delayed-scale update converges
+    geometrically from any initialization because EF re-feeds what the
+    warmup steps clipped or zeroed), step 0."""
+    return CompressionState(
+        ef=jnp.zeros((int(length),), jnp.float32),
+        scale=jnp.zeros((int(n_scales),), jnp.float32),
+        step=jnp.zeros((1,), jnp.float32),
+        spec=compressor.spec,
+        ef_version=EF_VERSION,
+    )
+
+
+def iter_compression_states(tree) -> List[CompressionState]:
+    """Every CompressionState in a pytree/container (checkpoint guard)."""
+    return [x for x in jax.tree.leaves(
+        tree, is_leaf=lambda x: isinstance(x, CompressionState))
+        if isinstance(x, CompressionState)]
+
+
+def compression_layout(tree) -> Optional[dict]:
+    """Compression config of every EF state inside ``tree`` (``None``
+    when there is none) — what the multi-node checkpointer persists in
+    its sidecar and compares on resume, exactly like the FSDP
+    world-size/num_buckets layout.  Sorted spec list so the comparison
+    is order-independent across save/restore tree walks."""
+    states = iter_compression_states(tree)
+    if not states:
+        return None
+    return {
+        "specs": sorted({s.spec for s in states}),
+        "n_states": len(states),
+        "ef_version": max(s.ef_version for s in states),
+    }
+
+
+__all__ = ["EF_VERSION", "CompressionState", "compression_layout",
+           "init_state", "iter_compression_states"]

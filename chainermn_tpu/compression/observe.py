@@ -1,0 +1,100 @@
+"""Compression observability — the ``compression_*`` metric family plus
+compute-tagged flight spans.
+
+Same zero-cost-when-disabled contract as ``parallel/fsdp._FsdpObs``:
+the seams obtain a :class:`CompressionObs` ONCE at build time; with the
+metrics switch and the flight recorder both off it is ``None`` and the
+traced program carries no callbacks at all.
+
+Metrics (labels ``seam`` ∈ {allreduce, fsdp}, ``bucket``, ``compressor``):
+
+* ``compression_bits_per_param`` (gauge) — achieved wire bits/param
+  including the chunk-grid pad and (FSDP) the piggybacked scale slot;
+* ``compression_wire_bytes_saved`` (counter) — bytes NOT moved per
+  collective vs the uncompressed f32 wire;
+* ``compression_residual_norm`` (gauge) — L2 norm of this rank's EF
+  residual after compress (the convergence health signal: a decaying /
+  flat-low residual is healthy, a growing one means the wire is too
+  narrow for the gradient stream).
+
+Flight spans: ``compress`` / ``decompress`` are recorded with
+``kind="compute"`` — a slow quantizer must show up in
+``identify_desync`` as a *compute straggler*, never as a wedged
+collective (the desync analysis only treats collective/object spans as
+cross-rank-symmetric progress markers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class CompressionObs:
+    """Begin/end edges for one compress or decompress region, delivered
+    from device-side ``jax.debug.callback``\\ s gated to rank 0 (one
+    event stream per process, like the FSDP overlap lane)."""
+
+    def __init__(self, flight, registry):
+        self.flight = flight
+        self.registry = registry
+        self._open: dict = {}
+        if registry is not None:
+            self._bits = registry.gauge(
+                "compression_bits_per_param",
+                "achieved wire bits per parameter (pad + scale overhead "
+                "included)")
+            self._saved = registry.counter(
+                "compression_wire_bytes_saved",
+                "wire bytes not moved vs an uncompressed f32 collective")
+            self._residual = registry.gauge(
+                "compression_residual_norm",
+                "L2 norm of this rank's error-feedback residual")
+
+    def edge(self, phase: str, edge: str, seam: str, bucket: int,
+             compressor: str, bits_per_param: float, bytes_saved: int,
+             residual_norm: Optional[float]) -> None:
+        labels = {"seam": seam, "bucket": str(bucket),
+                  "compressor": compressor}
+        key = (phase, seam, bucket)
+        if self.flight is not None:
+            if edge == "begin":
+                self._open[key] = self.flight.span_begin(
+                    "compute", f"{phase}:{seam}", bucket=bucket,
+                    compressor=compressor)
+            else:
+                tok = self._open.pop(key, None)
+                if tok is not None:
+                    self.flight.span_end(tok)
+        if self.registry is not None and edge == "end" \
+                and phase == "compress":
+            self._bits.set(bits_per_param, **labels)
+            self._saved.inc(bytes_saved, **labels)
+            if residual_norm is not None:
+                self._residual.set(residual_norm, **labels)
+
+    def make_callback(self, phase: str, edge: str, seam: str, bucket: int,
+                      compressor: str, bits_per_param: float,
+                      bytes_saved: int):
+        """A rank-gated debug callback for one edge.  Called with
+        ``(rank_idx, residual_norm, _dep)`` where ``_dep`` is a data
+        dependency pinning when the device reaches this edge."""
+
+        def cb(rank_idx, residual_norm, _dep):
+            if int(rank_idx) == 0:
+                self.edge(phase, edge, seam, bucket, compressor,
+                          bits_per_param, bytes_saved,
+                          float(residual_norm))
+        return cb
+
+
+def get_compression_obs() -> Optional[CompressionObs]:
+    """The build-time hook: ``None`` while observability is off."""
+    from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.observability import registry as _registry
+
+    fr = _flight.get_flight_recorder()
+    reg = _registry.get_registry() if _registry.enabled() else None
+    return CompressionObs(fr, reg) if (fr or reg) else None
+
+
+__all__ = ["CompressionObs", "get_compression_obs"]

@@ -29,6 +29,10 @@ import numpy as np
 # mismatched world fails loudly (ADVICE r5).  Underscored names cannot
 # collide with leaf keys.
 _FSDP_META_KEY = "__fsdp_meta__"
+# Gradient-compression config of any error-feedback state in the tree
+# (compressor specs + EF version): a resume under a different compressor
+# would silently mis-scale the restored residuals.
+_COMPRESSION_META_KEY = "__compression_meta__"
 
 
 def _flatten_state(state) -> Tuple[dict, Any]:
@@ -84,6 +88,13 @@ class _MultiNodeCheckpointer:
                 # persist the FsdpMeta-derived layout so resume() can
                 # validate world size / mode before touching the arrays
                 arrays[_FSDP_META_KEY] = np.array(json.dumps(layout))
+            from chainermn_tpu.compression import compression_layout
+            clayout = compression_layout(state)
+            if clayout is not None:
+                # ditto for error-feedback compression state (FSDP
+                # bucket compressors or a compressed optimizer)
+                arrays[_COMPRESSION_META_KEY] = np.array(
+                    json.dumps(clayout))
             # np.savez appends .npz when missing, so the temp name must
             # end in it
             tmp = self._file(iteration) + ".tmp.npz"
@@ -156,6 +167,36 @@ class _MultiNodeCheckpointer:
                     f"{saved['shard_lens']} does not match the live "
                     f"FsdpState layout {live['shard_lens']} — the model "
                     f"or packing changed since the save")
+        # Gradient-compression EF state: restoring residuals/scales saved
+        # under a DIFFERENT compressor config would feed mis-scaled error
+        # into every subsequent step — refuse with the fix spelled out
+        # (mirrors the num_buckets guard above).
+        from chainermn_tpu.compression import compression_layout
+        raw_c = arrays.pop(_COMPRESSION_META_KEY, None)
+        saved_c = json.loads(str(raw_c)) if raw_c is not None else None
+        live_c = compression_layout(state)
+        if saved_c is not None and live_c is None:
+            raise ValueError(
+                f"checkpoint {where} carries error-feedback compression "
+                f"state for {saved_c['specs']} but the resume target has "
+                f"no compression configured — rebuild with the same "
+                f"compression config (fsdp_init(bucket_compressors=...) "
+                f"/ create_multi_node_optimizer(compression=...)), or "
+                f"restart training fresh to drop the EF state")
+        if saved_c is None and live_c is not None:
+            raise ValueError(
+                f"checkpoint {where} has no compression state but the "
+                f"resume target expects EF state for {live_c['specs']} — "
+                f"resume into an uncompressed state and re-init, or save "
+                f"from a compressed run; EF residuals cannot be "
+                f"fabricated from an uncompressed checkpoint")
+        if saved_c is not None and saved_c != live_c:
+            raise ValueError(
+                f"checkpoint {where} compression config {saved_c} does "
+                f"not match the live config {live_c} — the EF residuals "
+                f"and delayed scales are bound to the compressor spec; "
+                f"pass the identical compression config, or restart "
+                f"fresh under the new one")
         # Generic leaf-shape validation (also catches a legacy FSDP
         # checkpoint without the sidecar, or a plain checkpoint resumed
         # into an FSDP target): every mismatch beats a cryptic unflatten

@@ -256,10 +256,26 @@ def identify_desync(states: Dict[int, dict]) -> dict:
     others are waiting for.  Only collective/object spans participate
     (transport/p2p/checkpoint spans are local diagnostics, not symmetric
     across ranks).
+
+    Open ``kind="compute"`` spans (e.g. the compression subsystem's
+    compress/decompress regions) are reported separately as
+    ``compute_stragglers``: a rank stuck in local compute is the CAUSE of
+    a stall, not a wedged collective, and must not be misattributed to
+    the wire — the slow-quantizer-looks-like-a-hang failure mode.
     """
     states = {int(r): s for r, s in states.items()}
     stalls: List[dict] = []
     desynced: set = set()
+    compute_stragglers: List[dict] = []
+    for r, s in states.items():
+        for rec in s.get("open", ()):
+            if rec.get("kind") == "compute":
+                compute_stragglers.append({
+                    "op": rec.get("op"),
+                    "rank": r,
+                    "age_s": float(rec.get("age_s", 0.0)),
+                })
+    compute_stragglers.sort(key=lambda x: -x["age_s"])
     ops = set()
     for s in states.values():
         for rec in s.get("open", ()):
@@ -294,6 +310,7 @@ def identify_desync(states: Dict[int, dict]) -> dict:
     return {
         "stalled_collectives": stalls,
         "desynced_ranks": sorted(desynced),
+        "compute_stragglers": compute_stragglers,
         "n_ranks": len(states),
     }
 

@@ -149,10 +149,11 @@ class InstrumentedCommunicator:
         return out
 
     # ---- gradient entry points (the hot path) ------------------------------
-    def allreduce_grad(self, grads):
+    def allreduce_grad(self, grads, *, compressor=None, state=None):
         return self._run_collective(
             "allreduce_grad", grads,
-            lambda: self._comm.allreduce_grad(grads))
+            lambda: self._comm.allreduce_grad(
+                grads, compressor=compressor, state=state))
 
     multi_node_mean_grad = allreduce_grad
 

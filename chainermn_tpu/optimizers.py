@@ -43,23 +43,71 @@ class _MultiNodeOptimizer:
     Reference: ``_MultiNodeOptimizer`` 〔optimizers.py〕, which delegated all
     attributes to the wrapped optimizer; here the optax interface is two
     functions, so delegation is explicit (`init`/`update` + passthrough).
+
+    ``compression`` (a stateless codec, i.e. :class:`NoCompression`) is
+    forwarded to ``allreduce_grad`` per call — ``NoCompression(wire)``
+    lowers to the exact cast-allreduce-cast program of the legacy
+    ``allreduce_grad_dtype`` knob.  Stateful quantizers live in
+    :class:`_CompressedOptimizer` instead (they thread EF state).
     """
 
-    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm,
+                 compression=None):
         self.actual_optimizer = actual_optimizer
         self.communicator = comm
+        self.compression = compression
 
     def init(self, params):
         return self.actual_optimizer.init(params)
 
     def update(self, grads, state, params=None, **kwargs):
-        grads = self.communicator.allreduce_grad(grads)
+        grads = self.communicator.allreduce_grad(
+            grads, compressor=self.compression)
         return self.actual_optimizer.update(grads, state, params, **kwargs)
 
     # pytree spec of this optimizer's state inside an SPMD train step:
     # everything is device-invariant (replicated).
     def state_partition_spec(self):
         return P()
+
+
+class _CompressedState(NamedTuple):
+    inner: Any   # wrapped optimizer's state (replicated)
+    comp: Any    # CompressionState — EF residual is per-rank (varying)
+
+
+class _CompressedOptimizer:
+    """Quantized gradient exchange: ``allreduce_grad(compressor=...)``
+    with the error-feedback state carried inside the optimizer state —
+    **beyond-reference extension** (see :mod:`chainermn_tpu.compression`).
+
+    The EF residual is device-varying (each rank remembers ITS
+    quantization error), so it rides the optimizer-state slot exactly the
+    way the double-buffer's pending gradients do: stacked ``[size, ...]``
+    outside the step, squeezed to the local state inside.
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm,
+                 compression):
+        self.actual_optimizer = actual_optimizer
+        self.communicator = comm
+        self.compression = compression
+
+    def init(self, params):
+        return _CompressedState(
+            inner=self.actual_optimizer.init(params),
+            comp=self.communicator.init_compression_state(
+                params, self.compression))
+
+    def update(self, grads, state, params=None, **kwargs):
+        grads, comp = self.communicator.allreduce_grad(
+            grads, compressor=self.compression, state=state.comp)
+        updates, inner = self.actual_optimizer.update(
+            grads, state.inner, params, **kwargs)
+        return updates, _CompressedState(inner=inner, comp=comp)
+
+    def state_partition_spec(self):
+        return _CompressedState(inner=P(), comp=_VARYING)
 
 
 class _DoubleBufferState(NamedTuple):
@@ -109,6 +157,27 @@ class _DoubleBufferingOptimizer:
 _VARYING = "__varying__"
 
 
+def _deprecate_raw_wire_knob(communicator, compression):
+    """One-release shim (satellite of the compression subsystem): a
+    communicator carrying a RAW ``allreduce_grad_dtype`` — i.e. the dtype
+    knob was passed directly rather than spelled as a compression codec —
+    still works unchanged, but points users at the replacement."""
+    if compression is not None:
+        return
+    dt = getattr(communicator, "allreduce_grad_dtype", None)
+    if dt is not None and getattr(communicator, "compression", None) is None:
+        import warnings
+        warnings.warn(
+            f"allreduce_grad_dtype={str(dt)!r} without an explicit "
+            "compression codec is deprecated; pass "
+            f"compression=NoCompression(wire_dtype={str(dt)!r}) (or just "
+            f"compression={str(dt)!r}) to create_communicator / "
+            "create_multi_node_optimizer instead — it lowers to the "
+            "identical cast-allreduce-cast program, and the raw dtype "
+            "knob will be removed in the release after next",
+            DeprecationWarning, stacklevel=3)
+
+
 class _ZeroState(NamedTuple):
     inner: Any  # inner optax state over THIS device's flat shard (varying)
 
@@ -143,9 +212,20 @@ class _Zero1Optimizer:
     --optimizer lars for this reason).
     """
 
-    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+    def __init__(self, actual_optimizer: optax.GradientTransformation, comm,
+                 compression=None):
         self.actual_optimizer = actual_optimizer
         self.communicator = comm
+        self.compression = compression
+
+    def _wire_dtype(self):
+        """The reduce-scatter leg's wire dtype: an explicit
+        ``NoCompression(wire_dtype)`` wins; else the communicator's legacy
+        ``allreduce_grad_dtype`` knob (deprecated spelling of the same)."""
+        if self.compression is not None and \
+                getattr(self.compression, "wire", None) is not None:
+            return self.compression.wire
+        return getattr(self.communicator, "allreduce_grad_dtype", None)
 
     def _shard_zeros(self, params):
         """Zero-filled flat shards shaped like one device's slice —
@@ -171,10 +251,10 @@ class _Zero1Optimizer:
         comm = self.communicator
         size = comm.size
         idx = comm.axis_index()
-        # honor the communicator's wire dtype (the pure_nccl fp16/bf16
-        # recipe): cast in, reduce in the wire dtype, cast back — same
-        # numerics as allreduce_grad's cast-allreduce-cast path
-        wire_dtype = getattr(comm, "allreduce_grad_dtype", None)
+        # honor the wire dtype (the pure_nccl fp16/bf16 recipe): cast in,
+        # reduce in the wire dtype, cast back — same numerics as
+        # allreduce_grad's cast-allreduce-cast path
+        wire_dtype = self._wire_dtype()
         g_bufs, meta = _packing.pack(grads)
         p_bufs, _ = _packing.pack(params) if params is not None else (
             [None] * len(g_bufs), None)
@@ -220,6 +300,7 @@ def create_multi_node_optimizer(
     communicator,
     double_buffering: bool = False,
     zero: bool = False,
+    compression=None,
 ):
     """Reference signature: ``create_multi_node_optimizer(optimizer, comm,
     double_buffering)`` 〔optimizers.py〕.  ``actual_optimizer`` is an optax
@@ -228,16 +309,53 @@ def create_multi_node_optimizer(
     ``zero=True`` (beyond-reference extension) shards the optimizer state
     ZeRO-1-style over the communicator's devices — see
     :class:`_Zero1Optimizer`.  Mutually exclusive with ``double_buffering``
-    (the pending-gradient buffer would defeat the memory saving)."""
+    (the pending-gradient buffer would defeat the memory saving).
+
+    ``compression`` (beyond-reference extension) selects the gradient
+    wire codec — a name (``"int8"``/``"fp8"``), dtype string, config
+    dict, or :class:`~chainermn_tpu.compression.Compressor`.
+    ``NoCompression(wire_dtype=...)`` reproduces the communicator-level
+    ``allreduce_grad_dtype`` program bit for bit; the quantizers carry
+    error-feedback state inside the optimizer state (initialize it with
+    :func:`init_opt_state`, which places the per-rank EF residual)."""
+    from chainermn_tpu.compression import base as _cbase
+    from chainermn_tpu.compression import quantize as _cq
+    compression = _cbase.resolve_compressor(compression)
+    _deprecate_raw_wire_knob(communicator, compression)
     if zero and double_buffering:
         raise ValueError("zero=True and double_buffering=True are mutually "
                          "exclusive (the pending full-size gradient buffer "
                          "would defeat ZeRO's memory saving)")
+    if _cq.is_quantizing(compression):
+        if zero:
+            raise NotImplementedError(
+                "compression=<quantizer> with zero=True is not supported "
+                "yet: ZeRO-1's reduce-scatter leg would need per-shard EF "
+                "state (the bucketed FSDP engine has that — use "
+                "fsdp_init(bucket_compressors=...))")
+        if double_buffering:
+            raise NotImplementedError(
+                "compression=<quantizer> with double_buffering=True is not "
+                "supported: stale-gradient buffering and error feedback "
+                "both delay the update stream; composing them changes "
+                "convergence semantics")
+        return _CompressedOptimizer(actual_optimizer, communicator,
+                                    compression)
     if zero:
-        return _Zero1Optimizer(actual_optimizer, communicator)
+        return _Zero1Optimizer(actual_optimizer, communicator,
+                               compression=compression)
     if double_buffering:
+        if compression is not None and compression.wire is not None:
+            raise NotImplementedError(
+                "compression=NoCompression(wire_dtype) with "
+                "double_buffering=True: set allreduce_grad_dtype on the "
+                "communicator instead (the pending-buffer allreduce "
+                "honors it)")
         return _DoubleBufferingOptimizer(actual_optimizer, communicator)
-    return _MultiNodeOptimizer(actual_optimizer, communicator)
+    if compression is not None and compression.wire is None:
+        compression = None  # bare NoCompression() is the do-nothing default
+    return _MultiNodeOptimizer(actual_optimizer, communicator,
+                               compression=compression)
 
 
 def _resolve_spec(spec_tree, axes):
@@ -329,6 +447,10 @@ def make_train_step(
             # stacked per-device shard states arrive as [1, ...] slices
             opt_state = _ZeroState(inner=jax.tree.map(
                 lambda a: jnp.squeeze(a, 0), opt_state.inner))
+        if isinstance(opt_state, _CompressedState):
+            # stacked per-device EF state arrives as [1, ...] slices
+            opt_state = opt_state._replace(comp=jax.tree.map(
+                lambda a: jnp.squeeze(a, 0), opt_state.comp))
         if with_model_state:
             model_state = jax.tree.map(lambda a: jnp.squeeze(a, 0), model_state)
         # Mark the replicated params device-varying for the local backward:
@@ -380,6 +502,9 @@ def make_train_step(
         if isinstance(opt_state, _ZeroState):
             opt_state = _ZeroState(inner=jax.tree.map(
                 lambda a: a[None], opt_state.inner))
+        if isinstance(opt_state, _CompressedState):
+            opt_state = opt_state._replace(comp=jax.tree.map(
+                lambda a: a[None], opt_state.comp))
         if with_model_state:
             model_state = jax.tree.map(lambda a: a[None], model_state)
         loss = comm.allreduce(loss, "mean")
@@ -488,6 +613,16 @@ def init_opt_state(communicator, optimizer, params):
             state.inner)
         return _ZeroState(inner=jax.device_put(
             stacked, NamedSharding(comm.mesh, P(comm.data_axes))))
+    if isinstance(state, _CompressedState):
+        # inner replicated; EF state stacked per device (each rank owns
+        # its residual; scale/step start — and stay — rank-identical)
+        stacked = jax.tree.map(
+            lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape),
+            state.comp)
+        return _CompressedState(
+            inner=jax.device_put(state.inner, NamedSharding(comm.mesh, P())),
+            comp=jax.device_put(
+                stacked, NamedSharding(comm.mesh, P(comm.data_axes))))
     if not isinstance(state, _DoubleBufferState):
         return jax.device_put(state, NamedSharding(comm.mesh, P()))
     stacked_pending = jax.tree.map(

@@ -179,6 +179,7 @@ class BucketLayout(NamedTuple):
     pads: tuple             # pad appended to each buffer (len = world pad)
     nbytes: int             # unpadded payload bytes of the bucket
     wire_dtype: Optional[str] = None  # per-bucket wire override (or None)
+    compressor: Optional[str] = None  # quantizer spec JSON (or None)
 
 
 class FsdpMeta(NamedTuple):
@@ -207,9 +208,15 @@ class FsdpState(NamedTuple):
     """Per-device persistent state: ``shards`` is a list (one entry per
     bucket) of lists of stacked [size, shard] leaves, sharded over the
     communicator's data axes (same layout convention as the ZeRO-1 inner
-    state and the double-buffer pending grads)."""
+    state and the double-buffer pending grads).  ``comp`` (compressed
+    buckets only) carries one stacked
+    :class:`~chainermn_tpu.compression.CompressionState` per bucket —
+    each rank's error-feedback residual over the bucket's full flat
+    buffer plus its OWN delayed scale exponent; ``()`` when no bucket is
+    quantized (the layout old checkpoints saved)."""
     shards: Any             # [bucket][buffer] -> [size, shard_len] params
     inner: Any              # inner optax state over the (squeezed) shards
+    comp: Any = ()          # [bucket] -> CompressionState | None (or ())
 
 
 def _normalize_wire(dtype) -> Optional[jnp.dtype]:
@@ -227,7 +234,8 @@ def fsdp_init(communicator, params, optimizer,
               allow_layerwise: bool = False,
               num_buckets: int = 1,
               bucket_bytes: Optional[int] = None,
-              bucket_wire_dtypes: Optional[Sequence] = None):
+              bucket_wire_dtypes: Optional[Sequence] = None,
+              bucket_compressors=None):
     """Shard ``params`` for stage-3 training.
 
     Returns ``(state, meta)``: ``state`` is the :class:`FsdpState` whose
@@ -251,10 +259,23 @@ def fsdp_init(communicator, params, optimizer,
       list (entries None fall back to the step's ``wire_dtype``), e.g.
       keep embedding buckets on a full-precision wire while the
       transformer-block buckets ride bf16.
+    * ``bucket_compressors`` — per-bucket gradient wire codec (single
+      value broadcast to all buckets, or a K-list; names / dtype strings
+      / config dicts / :class:`~chainermn_tpu.compression.Compressor`
+      instances, see :func:`~chainermn_tpu.compression.\
+resolve_compressor`).  ``NoCompression(wire_dtype=...)`` folds into the
+      bucket's ``wire_dtype`` (identical program); a quantizer
+      (``"int8"``/``"fp8"``) makes that bucket's gradient reduce-scatter
+      run over 1-byte codes with per-rank error feedback, carried in
+      ``state.comp`` — note the EF residual is full-bucket-sized per
+      rank (the standard EF memory cost).
     """
     _reject_multi_node_wrapper(optimizer)
     if not allow_layerwise:
         _reject_layerwise_optimizer(optimizer)
+    from chainermn_tpu.compression import base as _cbase
+    from chainermn_tpu.compression import error_feedback as _cef
+    from chainermn_tpu.compression import quantize as _cq
     comm = communicator
     size = comm.size
     leaves, treedef = jax.tree.flatten(params)
@@ -266,7 +287,17 @@ def fsdp_init(communicator, params, optimizer,
         raise ValueError(
             f"bucket_wire_dtypes has {len(bucket_wire_dtypes)} entries "
             f"but the partition produced {len(assignments)} buckets")
-    layouts, stacked = [], []
+    if bucket_compressors is None:
+        bucket_compressors = [None] * len(assignments)
+    elif not isinstance(bucket_compressors, (list, tuple)):
+        bucket_compressors = [bucket_compressors] * len(assignments)
+    elif len(bucket_compressors) != len(assignments):
+        raise ValueError(
+            f"bucket_compressors has {len(bucket_compressors)} entries "
+            f"but the partition produced {len(assignments)} buckets")
+    bucket_compressors = [_cbase.resolve_compressor(c)
+                          for c in bucket_compressors]
+    layouts, stacked, comp_states = [], [], []
     for a in assignments:
         bufs, pack_meta = _packing.pack(list(leaves[a.start:a.stop]))
         orig_lens, pads, bucket_stacked = [], [], []
@@ -279,12 +310,49 @@ def fsdp_init(communicator, params, optimizer,
         if bucket_wire_dtypes is not None \
                 and bucket_wire_dtypes[a.index] is not None:
             wire = str(_normalize_wire(bucket_wire_dtypes[a.index]))
+        comp = bucket_compressors[a.index]
+        comp_spec, cstate = None, None
+        if isinstance(comp, _cbase.NoCompression):
+            # the identity codec IS the wire-dtype knob: fold it in so
+            # the step traces the exact uncompressed program
+            if comp.wire is not None:
+                if wire is not None and wire != str(comp.wire):
+                    raise ValueError(
+                        f"bucket {a.index}: bucket_wire_dtypes={wire!r} "
+                        f"conflicts with bucket_compressors="
+                        f"NoCompression(wire_dtype={comp.wire_dtype!r}) "
+                        "— pass only one spelling")
+                wire = str(comp.wire)
+        elif _cq.is_quantizing(comp):
+            # quantizers ride ONE flat float buffer per bucket; mixed
+            # dtype groups would need per-group EF state
+            if len(bucket_stacked) != 1 or not jnp.issubdtype(
+                    bucket_stacked[0].dtype, jnp.floating):
+                raise NotImplementedError(
+                    f"bucket {a.index}: compressor {comp.name!r} needs a "
+                    f"single float packed buffer, got "
+                    f"{[str(s.dtype) for s in bucket_stacked]} — keep "
+                    "integer/mixed-dtype leaves in an uncompressed "
+                    "bucket")
+            comp.clip_limit(size)  # raise early at unworkable world sizes
+            comp_spec = comp.spec
+            n_full = int(bucket_stacked[0].shape[1]) * size
+            cstate = _cef.CompressionState(
+                ef=jnp.zeros((n_full,), jnp.float32),
+                scale=jnp.zeros((1,), jnp.float32),
+                step=jnp.zeros((1,), jnp.float32),
+                spec=comp.spec, ef_version=_cef.EF_VERSION)
+        elif comp is not None:
+            raise TypeError(f"bucket {a.index}: cannot use {comp!r} as a "
+                            "bucket compressor")
         layouts.append(BucketLayout(
             start=a.start, stop=a.stop, pack_meta=pack_meta,
             orig_lens=tuple(orig_lens),
             shard_lens=tuple(int(s.shape[1]) for s in bucket_stacked),
-            pads=tuple(pads), nbytes=a.nbytes, wire_dtype=wire))
+            pads=tuple(pads), nbytes=a.nbytes, wire_dtype=wire,
+            compressor=comp_spec))
         stacked.append(bucket_stacked)
+        comp_states.append(cstate)
     meta = FsdpMeta(treedef=treedef, n_leaves=len(leaves),
                     buckets=tuple(layouts))
     # inner state over one device's shard shapes (identical zeros on every
@@ -295,9 +363,18 @@ def fsdp_init(communicator, params, optimizer,
     stacked_inner = jax.tree.map(
         lambda z: jnp.broadcast_to(z, (size,) + z.shape), inner)
     sharding = NamedSharding(comm.mesh, P(comm.data_axes))
+    if all(c is None for c in comp_states):
+        comp_out = ()
+    else:
+        comp_out = jax.device_put(
+            jax.tree.map(
+                lambda z: jnp.broadcast_to(z, (size,) + z.shape),
+                comp_states),
+            sharding)
     return FsdpState(
         shards=jax.device_put(stacked, sharding),
         inner=jax.device_put(stacked_inner, sharding),
+        comp=comp_out,
     ), meta
 
 
@@ -328,13 +405,20 @@ def fsdp_layout(tree) -> Optional[dict]:
     sizes = sorted({int(jnp.shape(b)[0])
                     for st in states for b in jax.tree.leaves(st.shards)})
     n_buckets = sorted({len(st.shards) for st in states})
-    return {
+    layout = {
         "world_size": sizes[0] if len(sizes) == 1 else sizes,
         "num_buckets": n_buckets[0] if len(n_buckets) == 1 else n_buckets,
         "shard_lens": [[[int(jnp.shape(b)[1]) for b in bucket]
                         for bucket in st.shards] for st in states],
         "n_states": len(states),
     }
+    # bucket compression rides the same sidecar, but ONLY when present —
+    # uncompressed layouts stay byte-identical to pre-compression saves
+    from chainermn_tpu.compression import compression_layout as _clayout
+    comp = _clayout([getattr(st, "comp", ()) for st in states])
+    if comp is not None:
+        layout["compression"] = comp
+    return layout
 
 
 def fsdp_full_params(state: FsdpState, meta: FsdpMeta):
@@ -348,6 +432,97 @@ def fsdp_full_params(state: FsdpState, meta: FsdpMeta):
         flat = [b.reshape(-1)[:n] for b, n in zip(bufs, bl.orig_lens)]
         leaves.extend(_packing.unpack(flat, bl.pack_meta))
     return jax.tree.unflatten(meta.treedef, leaves)
+
+
+# ---- quantized bucket exchange ---------------------------------------------
+
+def _make_compressed_gather(comp, layout, wire, axis_arg, size, cobs,
+                            bucket: int):
+    """The custom-VJP gather for ONE quantized bucket — the seam where
+    compression meets the bucketed schedule.
+
+    Forward: ``all_gather`` of ``concat(shard, own_scale_exponent)`` —
+    the 1-slot piggyback redistributes every rank's delayed scale on the
+    parameter gather itself, so the backward quantizes against the full,
+    rank-identical exponent vector with ZERO extra collectives (power-of-
+    two exponents are exactly representable in any float wire dtype).
+
+    Backward — the compressed reduce-scatter: error-feedback add, encode
+    to overflow-safe wire codes (clipped to ``max_code/size`` so in-wire
+    summation cannot saturate), append one saturation flag per
+    destination shard (the clip count rides the scatter, mirroring the
+    forward's exponent piggyback), ``psum_scatter`` the CODES in wire
+    arithmetic, decode this rank's summed shard by its OWN scale, and
+    update the owned exponent from the summed amax and clip count —
+    without the count, gradient cancellation across ranks keeps the
+    summed amax small while every rank clips, wedging the scale below
+    the signal forever.  The new
+    :class:`~chainermn_tpu.compression.CompressionState` leaves the
+    backward as the *cotangent of the state input*: ``jax.grad`` over a
+    ``(shards, comp)`` carry hands it back alongside the gradient
+    shards, which is what lets the EF state thread through
+    ``jax.value_and_grad`` without restructuring the step.
+    """
+    L = int(layout.shard_lens[0])
+    item = jnp.dtype(comp.wire).itemsize
+    bits_per_param = item * 8.0
+    bytes_saved = L * size * (4 - item)
+
+    @jax.custom_vjp
+    def cgather(shard, cstate):
+        full, _ = _fwd(shard, cstate)
+        return full
+
+    def _fwd(shard, cstate):
+        orig = shard.dtype
+        ext = jnp.concatenate([shard.astype(jnp.float32),
+                               cstate.scale.astype(jnp.float32)])
+        if wire is not None:
+            ext = ext.astype(wire)
+        g = lax.all_gather(ext, axis_arg, tiled=True).reshape(size, L + 1)
+        full = g[:, :L].reshape(-1).astype(orig)
+        e_vec = g[:, L].astype(jnp.float32)
+        return full, (e_vec, cstate)
+
+    def _bwd(res, ct):
+        e_vec, cstate = res
+        rank = lax.axis_index(axis_arg)
+        scale_pos = jnp.repeat(jnp.exp2(e_vec), L)
+        v = ct.astype(jnp.float32) + cstate.ef
+        if cobs is not None:
+            jax.debug.callback(
+                cobs.make_callback("compress", "begin", "fsdp", bucket,
+                                   comp.name, bits_per_param, bytes_saved),
+                rank, 0.0, v[0])
+        key = comp.make_key(cstate.step[0], rank)
+        codes = comp.encode(v, scale_pos, key, size)
+        new_ef = v - comp.decode(codes, scale_pos)
+        if cobs is not None:
+            jax.debug.callback(
+                cobs.make_callback("compress", "end", "fsdp", bucket,
+                                   comp.name, bits_per_param, bytes_saved),
+                rank, jnp.sqrt(jnp.sum(jnp.square(new_ef))), codes[0])
+        flags = comp.saturation_flags(v, scale_pos, size, L)
+        ext = jnp.concatenate([codes.reshape(size, L), flags[:, None]],
+                              axis=1).reshape(-1)
+        summed = lax.psum_scatter(ext, axis_arg, tiled=True)
+        # my slot of e_vec is my own (current) exponent by construction;
+        # the trailing slot is my shard's summed clip count
+        gshard = summed[:L].astype(jnp.float32) * jnp.exp2(cstate.scale[0])
+        if cobs is not None:
+            jax.debug.callback(
+                cobs.make_callback("decompress", "end", "fsdp", bucket,
+                                   comp.name, bits_per_param, bytes_saved),
+                rank, 0.0, gshard[0])
+        amax = jnp.max(jnp.abs(gshard))[None]
+        new_e = comp.next_exponent(cstate.scale, amax, size,
+                                   summed[L:].astype(jnp.float32))
+        new_state = cstate._replace(ef=new_ef, scale=new_e,
+                                    step=cstate.step + 1.0)
+        return gshard.astype(ct.dtype), new_state
+
+    cgather.defvjp(_fwd, _bwd)
+    return cgather
 
 
 # ---- observability ----------------------------------------------------------
@@ -526,6 +701,22 @@ def make_fsdp_train_step(
         else default_wire
         for bl in meta.buckets]
     K = len(meta.buckets)
+    # Quantized buckets (fsdp_init(bucket_compressors=...)).  When none
+    # are, every branch below is statically dead and the step traces the
+    # exact pre-compression program — the bit-for-bit contract.
+    from chainermn_tpu.compression import base as _cbase
+    from chainermn_tpu.compression import observe as _cobs_mod
+    bucket_comps = [
+        _cbase.resolve_compressor(bl.compressor)
+        if getattr(bl, "compressor", None) else None
+        for bl in meta.buckets]
+    any_compressed = any(c is not None for c in bucket_comps)
+    if any_compressed and accum_steps > 1:
+        raise NotImplementedError(
+            "accum_steps > 1 with quantized buckets is not supported: "
+            "error feedback would advance once per MICROBATCH, changing "
+            "the accumulation semantics — accumulate uncompressed or "
+            "drop the bucket's compressor")
 
     # Observability is bound at BUILD time: with both switches off the
     # traced program carries no callbacks and the bare jitted step is
@@ -535,6 +726,11 @@ def make_fsdp_train_step(
     fr = _flight.get_flight_recorder()
     reg = _registry.get_registry() if _registry.enabled() else None
     obs = _FsdpObs(fr, reg, K, prefetch) if (fr or reg) else None
+    cobs = _cobs_mod.get_compression_obs() if any_compressed else None
+    cgathers = [
+        None if c is None else _make_compressed_gather(
+            c, meta.buckets[i], bucket_wires[i], axis_arg, size, cobs, i)
+        for i, c in enumerate(bucket_comps)]
 
     def _wire_nbytes(i: int) -> int:
         # the wire moves the PADDED buffers (shard_len * size elements
@@ -547,6 +743,8 @@ def make_fsdp_train_step(
     def step(state, model_state, batch):
         shards = jax.tree.map(lambda a: jnp.squeeze(a, 0), state.shards)
         inner = jax.tree.map(lambda a: jnp.squeeze(a, 0), state.inner)
+        comp = (jax.tree.map(lambda a: jnp.squeeze(a, 0), state.comp)
+                if any_compressed else None)
         if with_model_state:
             model_state = jax.tree.map(
                 lambda a: jnp.squeeze(a, 0), model_state)
@@ -579,12 +777,13 @@ def make_fsdp_train_step(
                     me, full[0].reshape(-1)[0])
             return full
 
-        def local_loss(shards_, model_state_, batch_):
+        def local_loss(carry, model_state_, batch_):
             # Issue the per-bucket gathers in bucket order under the
             # prefetch window: bucket i may not start gathering until
             # bucket i-1-prefetch finished (at most prefetch+1 gathers in
             # flight).  The barrier's custom VJP mirrors the pin onto the
             # backward, windowing the per-bucket reduce-scatters too.
+            shards_, comp_ = carry if any_compressed else (carry, None)
             gathered = []
             leaves = []
             for i, bufs in enumerate(shards_):
@@ -595,7 +794,13 @@ def make_fsdp_train_step(
                     # the forward consumes the anchor's post-barrier
                     # values, keeping the pin live in the graph
                     gathered[i - prefetch - 1] = list(pinned[len(bufs):])
-                gathered.append(gather_bucket(i, bufs))
+                if cgathers[i] is not None:
+                    # quantized bucket: same pinned slot in the gather
+                    # order, compressed gradient leg in the transpose
+                    full = cgathers[i](bufs[0], comp_[i])
+                    gathered.append([full[:meta.buckets[i].orig_lens[0]]])
+                else:
+                    gathered.append(gather_bucket(i, bufs))
             for bl, full in zip(meta.buckets, gathered):
                 leaves.extend(_packing.unpack(full, bl.pack_meta))
             params = jax.tree.unflatten(meta.treedef, leaves)
@@ -605,26 +810,34 @@ def make_fsdp_train_step(
 
         grad_fn = jax.value_and_grad(
             local_loss, has_aux=has_aux or with_model_state)
+        carry0 = (shards, comp) if any_compressed else shards
 
         def compute(model_state_, batch_):
             if with_model_state:
-                (loss, packed), gshards = grad_fn(shards, model_state_,
-                                                  batch_)
+                (loss, packed), gcarry = grad_fn(carry0, model_state_,
+                                                 batch_)
                 model_state_, aux = packed if has_aux else (packed, None)
             elif has_aux:
-                (loss, aux), gshards = grad_fn(shards, None, batch_)
+                (loss, aux), gcarry = grad_fn(carry0, None, batch_)
             else:
-                loss, gshards = grad_fn(shards, None, batch_)
+                loss, gcarry = grad_fn(carry0, None, batch_)
                 aux = None
-            return loss, aux, model_state_, gshards
+            return loss, aux, model_state_, gcarry
 
         if accum_steps > 1:
             from chainermn_tpu.utils.accum import accumulate_microbatches
 
-            loss, aux, model_state, gshards = accumulate_microbatches(
+            loss, aux, model_state, gcarry = accumulate_microbatches(
                 compute, model_state, batch, accum_steps, has_aux)
         else:
-            loss, aux, model_state, gshards = compute(model_state, batch)
+            loss, aux, model_state, gcarry = compute(model_state, batch)
+        if any_compressed:
+            # the comp "gradient" IS the advanced EF state (cotangent
+            # smuggling via the custom VJP) — mean-normalization below
+            # must not touch it
+            gshards, comp = gcarry
+        else:
+            gshards = gcarry
         if obs is not None:
             # the per-bucket reduce-scatters run inside the transpose:
             # their shared begin edge is the backward starting (the loss
@@ -654,7 +867,9 @@ def make_fsdp_train_step(
 
         state = FsdpState(
             shards=jax.tree.map(lambda s: s[None], shards),
-            inner=jax.tree.map(lambda a: a[None], inner))
+            inner=jax.tree.map(lambda a: a[None], inner),
+            comp=(jax.tree.map(lambda a: a[None], comp)
+                  if any_compressed else state.comp))
         if with_model_state:
             model_state = jax.tree.map(lambda a: a[None], model_state)
         if not global_loss:
@@ -667,7 +882,8 @@ def make_fsdp_train_step(
 
     state_spec = FsdpState(
         shards=[[P(axes)] * len(bl.shard_lens) for bl in meta.buckets],
-        inner=P(axes))
+        inner=P(axes),
+        comp=([P(axes)] * K if any_compressed else P(axes)))
     out_spec_all = (state_spec, P(axes), P(), P())
     keep = (True, with_model_state, True, has_aux)
     out_specs = tuple(s for s, k in zip(out_spec_all, keep) if k)

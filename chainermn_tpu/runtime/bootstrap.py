@@ -222,7 +222,12 @@ def init_distributed(
         # cross-process CPU collectives (the tests' multi-host analogue)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     if local_device_count is not None:
-        jax.config.update("jax_num_cpu_devices", local_device_count)
+        # jax < 0.5 has no jax_num_cpu_devices option; fall back to the
+        # XLA_FLAGS knob (must land before the first backend exists,
+        # which holds here — bootstrap precedes any jax.devices() call)
+        from chainermn_tpu.utils.cpu_mesh import _set_cpu_device_flags
+
+        _set_cpu_device_flags(local_device_count)
 
     jax.distributed.initialize(
         coordinator_address=jax_coord,

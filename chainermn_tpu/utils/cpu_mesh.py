@@ -24,16 +24,21 @@ def _set_cpu_device_flags(n: int) -> None:
     those versions this must run before any backend exists.
     """
     import os
+    import re
 
     import jax
 
     try:
         jax.config.update("jax_num_cpu_devices", n)
     except AttributeError:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n}")
+        # Replace any inherited count rather than defer to it: a spawned
+        # worker inherits its parent's XLA_FLAGS (e.g. the test suite's
+        # 8-device mesh) but needs its OWN local device count.
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags.strip() + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
 
 
 def _backend_uninitialized() -> bool:

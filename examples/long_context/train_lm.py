@@ -26,6 +26,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.models import TransformerLM
+from chainermn_tpu.utils import shard_map
+from chainermn_tpu.utils.jaxpr_audit import assert_no_captured_constants
 
 
 def make_motif_task(n, seq_len, vocab, motif_len=16, seed=0):
@@ -129,10 +131,10 @@ def main():
             # path of --attention ring_flash/flash) trips a dynamic_slice
             # vma check inside shard_map; on TPU the kernel is compiled and
             # no check is skipped.
-            return jax.shard_map(sp_body, mesh=mesh,
-                                 in_specs=(P(), P(None, "sp")),
-                                 out_specs=P(),
-                                 check_vma=False)(p_, tk)
+            return shard_map(sp_body, mesh=mesh,
+                             in_specs=(P(), P(None, "sp")),
+                             out_specs=P(),
+                             check_vma=False)(p_, tk)
         toks = jax.device_put(toks, NamedSharding(mesh, P(None, "sp")))
     else:
         def loss_fn(p_, tk):
@@ -160,6 +162,11 @@ def main():
         fsdp_step = make_fsdp_train_step(
             comm, sp_body, opt, meta, batch_spec=P(None, "sp"),
             global_loss=True, check_vma=False)
+        # every operand (state, batch) must be an explicit step argument;
+        # a capture here would re-embed device arrays in the (remote-)
+        # compile request — the round-5 HTTP 413 failure
+        assert_no_captured_constants(fsdp_step, fsdp_state, toks,
+                                     name="fsdp_step")
         for i in range(args.steps):
             fsdp_state, loss = fsdp_step(fsdp_state, toks)
             if sync_each or i % 10 == 0 or i == args.steps - 1:
@@ -174,6 +181,11 @@ def main():
             updates, s_ = opt.update(g, s_, p_)
             return optax.apply_updates(p_, updates), s_, l
 
+        # params/opt_state/toks are explicit jit args; audit that nothing
+        # device-resident is closure-captured (round-5 root cause: such
+        # constants embed in the remote-compile request)
+        assert_no_captured_constants(step, params, opt_state, toks,
+                                     name="step")
         for i in range(args.steps):
             params, opt_state, loss = step(params, opt_state, toks)
             if sync_each or i % 10 == 0 or i == args.steps - 1:

@@ -65,6 +65,11 @@ def main():
     parser.add_argument("--allreduce-grad-dtype", default=None,
                         help="communication dtype (xla communicator only), "
                              "e.g. bfloat16")
+    parser.add_argument("--compression", default=None,
+                        help="gradient wire compression: a registry name "
+                             "(int8/fp8), a bare wire dtype (bfloat16), or "
+                             "a compressor spec JSON — see "
+                             "docs/compression.md")
     parser.add_argument("--intra-size", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--observability", action="store_true",
@@ -97,6 +102,8 @@ def main():
               f"epochs: {args.epoch}")
         if args.double_buffering:
             print("Using double buffering (1-step-stale gradients)")
+        if args.compression:
+            print(f"Gradient wire compression: {args.compression}")
         print("==========================================")
 
     model = MLP(args.unit, 10)
@@ -105,7 +112,8 @@ def main():
     params = comm.bcast_data(params)  # identical start everywhere
 
     optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(1e-3), comm, double_buffering=args.double_buffering)
+        optax.adam(1e-3), comm, double_buffering=args.double_buffering,
+        compression=args.compression)
     opt_state = init_opt_state(comm, optimizer, params)
 
     def loss_fn(p, batch):

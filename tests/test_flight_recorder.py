@@ -170,11 +170,27 @@ class TestIdentifyDesync:
         assert out["stalled_collectives"] == []
         assert out["desynced_ranks"] == []
 
+    def test_compute_spans_reported_as_stragglers(self):
+        """A slow quantizer (compress/decompress span, kind="compute")
+        shows up as a compute straggler, never as a wedged collective —
+        the rank is the CAUSE of the stall, not blocked on the wire."""
+        s0 = _state({"allreduce": 5})
+        s0["open"] = [{"kind": "compute", "op": "compress:fsdp",
+                       "op_seq": 1, "ts": 0.0, "age_s": 12.5},
+                      {"kind": "compute", "op": "decompress:allreduce",
+                       "op_seq": 2, "ts": 0.0, "age_s": 30.0}]
+        out = identify_desync({0: s0, 1: _state({"allreduce": 5})})
+        assert out["stalled_collectives"] == []
+        assert out["desynced_ranks"] == []
+        assert out["compute_stragglers"] == [
+            {"op": "decompress:allreduce", "rank": 0, "age_s": 30.0},
+            {"op": "compress:fsdp", "rank": 0, "age_s": 12.5}]
+
     def test_no_open_spans(self):
         out = identify_desync({0: _state({"allreduce": 5}),
                                1: _state({"allreduce": 5})})
         assert out == {"stalled_collectives": [], "desynced_ranks": [],
-                       "n_ranks": 2}
+                       "compute_stragglers": [], "n_ranks": 2}
 
 
 # ---- config -----------------------------------------------------------------

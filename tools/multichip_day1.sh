@@ -103,6 +103,12 @@ run 2 "$OUT/FSDP_OVERLAP_$ROUND.json" \
         --buckets 1,2,4,8 --prefetch 0,1,2 --wire-dtype bfloat16 \
         > '$OUT/FSDP_OVERLAP_$ROUND.json'"
 
+run 2 "$OUT/COMPRESSION_$ROUND.json" \
+    "gradient-compression sweep on REAL chips (docs/compression.md: the CPU mesh pins the wire census — K gathers/K scatters, int8 reduce-scatter bytes >=3.5x under f32, no extra collectives — but folds wire casts, so step_ms per compressor x bucket ON ICI is the bandwidth measurement; compare against the FSDP_OVERLAP leg's uncompressed times)" -- \
+    bash -c "$PY_TPU benchmarks/bench_compression.py --json \
+        --compressors none,none:bfloat16,int8,fp8 --buckets 1,4 \
+        > '$OUT/COMPRESSION_$ROUND.json'"
+
 # ---- full-shape configs on the slice ----------------------------------
 
 run 4 "$OUT/RUN_CONFIGS_$ROUND.json" \

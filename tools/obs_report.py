@@ -11,7 +11,10 @@ schema — and prints:
 * per-step summary         (phase breakdown + throughput, from
                             ``step_report`` lines);
 * straggler section        (latest ``straggler_report`` line);
-* bench results            (``bench`` / ``bench_allreduce`` lines).
+* bench results            (``bench`` / ``bench_allreduce`` lines);
+* compression lane         (``compression_*`` metric lines — wire
+                            bits/param, bytes saved, EF residual; also
+                            available alone via ``--compression``).
 
 ``--flight`` switches to hang-dump mode: merge the per-rank
 ``flight_<rank>.json`` files a watchdog (or crash handler) wrote into one
@@ -168,11 +171,52 @@ def bench_section(records: List[dict]) -> str:
     return "bench results\n" + _table(["kind"] + keys, rows)
 
 
+def compression_section(records: List[dict]) -> str:
+    """Gradient-compression lane: one row per (seam, bucket, compressor)
+    series from the ``compression_*`` metric family — achieved wire
+    bits/param, the implied ratio vs an f32 wire, cumulative bytes kept
+    off the wire, and the error-feedback residual norm (the convergence
+    health signal: decaying/flat-low is healthy, growing means the wire
+    is too narrow for the gradient stream)."""
+    latest = _latest_metric_lines(records)
+    series: Dict[tuple, dict] = {}
+    for (name, labels), r in latest.items():
+        if not str(name).startswith("compression_"):
+            continue
+        ld = dict(labels)
+        key = (ld.get("seam", "?"), ld.get("bucket", "?"),
+               ld.get("compressor", "?"))
+        d = series.setdefault(key, {})
+        if name == "compression_bits_per_param":
+            d["bits"] = r.get("value")
+        elif name == "compression_wire_bytes_saved":
+            d["saved"] = r.get("value", 0.0)
+        elif name == "compression_residual_norm":
+            d["residual"] = r.get("value")
+    if not series:
+        return ("compression: no compression_* metrics "
+                "(wire uncompressed or observability off)")
+    rows = []
+    for (seam, bucket, comp), d in sorted(series.items()):
+        bits = d.get("bits")
+        rows.append([
+            seam, str(bucket), comp,
+            f"{bits:.2f}" if bits is not None else "-",
+            f"{32.0 / bits:.2f}x" if bits else "-",
+            _fmt_bytes(d.get("saved", 0.0)) if "saved" in d else "-",
+            f"{d['residual']:.3e}" if d.get("residual") is not None else "-",
+        ])
+    return "compression summary\n" + _table(
+        ["seam", "bucket", "compressor", "bits/param", "vs f32",
+         "bytes saved", "ef residual"], rows)
+
+
 SECTIONS = {
     "collectives": collectives_section,
     "steps": steps_section,
     "straggler": straggler_section,
     "bench": bench_section,
+    "compression": compression_section,
 }
 
 
@@ -270,6 +314,13 @@ def flight_desync_section(dumps: List[dict]) -> str:
     if rows:
         out += "\n" + _table(
             ["op", "seq", "waiting", "desynced", "positions"], rows)
+    stragglers = analysis.get("compute_stragglers", [])
+    if stragglers:
+        srows = [[str(s.get("rank", "?")), str(s.get("op", "?")),
+                  _fmt_s(s.get("age_s"))] for s in stragglers]
+        out += ("\ncompute straggler(s) — rank(s) stuck in local compute "
+                "(e.g. compress/decompress), not in a collective:\n"
+                + _table(["rank", "op", "open for"], srows))
     return out
 
 
@@ -404,6 +455,9 @@ def main(argv=None) -> int:
                          "flight_*.json dump files / a directory of them")
     ap.add_argument("--section", choices=sorted(SECTIONS),
                     help="print only one section")
+    ap.add_argument("--compression", action="store_true",
+                    help="print only the gradient-compression lane "
+                         "(shorthand for --section compression)")
     ap.add_argument("--flight", action="store_true",
                     help="merge per-rank flight_<rank>.json hang dumps "
                          "into one timeline")
@@ -427,8 +481,10 @@ def main(argv=None) -> int:
     if not records:
         print(f"no records in {args.path[0]}", file=sys.stderr)
         return 1
+    if args.compression and not args.section:
+        args.section = "compression"
     names = [args.section] if args.section else \
-        ["steps", "collectives", "straggler", "bench"]
+        ["steps", "collectives", "straggler", "bench", "compression"]
     print("\n\n".join(SECTIONS[n](records) for n in names))
     return 0
 
