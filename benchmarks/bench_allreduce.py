@@ -175,43 +175,19 @@ def main():
     return results
 
 
-_HLO_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4,
-                    "u32": 4, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
-                    "s8": 1, "u8": 1, "pred": 1}
-
-
 def _collective_ops(hlo_text):
     """Parse the collectives out of optimized HLO text: op kind, moved
-    bytes (from the result shape), and the replica/device groups."""
-    import re
+    bytes (from the result shape), the replica/device groups, and dtype.
 
-    pat = re.compile(
-        r"=\s*(\([^)]*\)|\S+)\s+"
-        r"(all-reduce(?:-start)?|reduce-scatter|all-gather(?:-start)?|"
-        r"all-to-all|collective-permute(?:-start)?|ragged-all-to-all)\(")
-    ops = []
-    for line in hlo_text.splitlines():
-        m = pat.search(line)
-        if not m:
-            continue
-        shape_txt, opname = m.group(1), m.group(2).replace("-start", "")
-        size = 0
-        for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape_txt):
-            if dt not in _HLO_DTYPE_BYTES:
-                continue
-            count = 1
-            for d in dims.split(","):
-                if d:
-                    count *= int(d)
-            size += count * _HLO_DTYPE_BYTES[dt]
-        # groups text carries commas inside braces ({{0,1},{2,3}}) or the
-        # iota form [2,4]<=[8]; match either shape whole
-        groups = re.search(
-            r"replica_groups=(\{(?:[^{}]|\{[^{}]*\})*\}"
-            r"|\[[^\]]*\](?:<=\[[^\]]*\])?)", line)
-        ops.append({"op": opname, "bytes": size,
-                    "groups": groups.group(1) if groups else None})
-    return ops
+    Delegates to the shared parser in :mod:`chainermn_tpu.analysis.hlo`
+    (one parser for the census artifact, the test gate, and the cmn-lint
+    rules — this used to be a private regex that could drift from the
+    test's copy).  Record keys op/bytes/groups are the committed
+    CENSUS_r*.json contract; dtype rides along.
+    """
+    from chainermn_tpu.analysis.hlo import collective_census
+
+    return collective_census(hlo_text)
 
 
 def _census(args):

@@ -30,6 +30,12 @@ _EXPORTS = {
     "normalize_image": "chainermn_tpu.datasets",
     # runtime observability (beyond-reference subsystem)
     "instrument_communicator": "chainermn_tpu.observability",
+    # cmn-lint trace-time static analysis (beyond-reference subsystem)
+    "lint_step": "chainermn_tpu.analysis",
+    "LintError": "chainermn_tpu.analysis",
+    "LintReport": "chainermn_tpu.analysis",
+    "extract_schedule": "chainermn_tpu.analysis",
+    "CollectiveSchedule": "chainermn_tpu.analysis",
     # gradient compression wires (beyond-reference subsystem)
     "Compressor": "chainermn_tpu.compression",
     "NoCompression": "chainermn_tpu.compression",

@@ -1,0 +1,59 @@
+"""cmn-lint — trace-time SPMD static analysis.
+
+Every hang class the runtime observability stack (flight recorder, hang
+watchdog — PR 2) diagnoses *after* a mesh is wedged is statically
+visible in the jaxpr/HLO before a single step runs.  This package is
+that check: a :class:`CollectiveSchedule` extractor over traced jaxprs
+and compiled HLO, a rule registry (``schedule-desync``,
+``census-drift``, ``unpinned-transpose``, ``captured-constant``,
+``donation-alias``, ``wire-dtype-mismatch``, ``async-pair``), the
+:func:`lint_step` one-liner, and the named entry points behind
+``tools/cmn_lint.py``.  Rule catalog: ``docs/static_analysis.md``.
+"""
+
+from chainermn_tpu.analysis.captured import (
+    CapturedConstantError,
+    DEFAULT_MAX_BYTES,
+    assert_no_captured_constants,
+    find_captured_constants,
+)
+from chainermn_tpu.analysis.hlo import (
+    HloCollective,
+    HloParse,
+    collective_census,
+    parse_hlo_collectives,
+)
+from chainermn_tpu.analysis.lint import (
+    LintContext,
+    LintError,
+    LintReport,
+    allreduce_hlo,
+    build_grad_probe,
+    lint_step,
+)
+from chainermn_tpu.analysis.rules import (
+    EXPECTED_DECOMPOSITION,
+    Finding,
+    all_rules,
+    expected_kinds,
+    get_rule,
+    rule,
+)
+from chainermn_tpu.analysis.schedule import (
+    COLLECTIVE_PRIMITIVES,
+    CollectiveOp,
+    CollectiveSchedule,
+    extract_schedule,
+    schedule_from_hlo,
+)
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES", "CapturedConstantError",
+    "CollectiveOp", "CollectiveSchedule", "DEFAULT_MAX_BYTES",
+    "EXPECTED_DECOMPOSITION", "Finding", "HloCollective", "HloParse",
+    "LintContext", "LintError", "LintReport", "all_rules",
+    "allreduce_hlo", "assert_no_captured_constants", "build_grad_probe",
+    "collective_census", "expected_kinds", "extract_schedule",
+    "find_captured_constants", "get_rule", "lint_step",
+    "parse_hlo_collectives", "rule", "schedule_from_hlo",
+]

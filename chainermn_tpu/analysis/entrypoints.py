@@ -1,0 +1,190 @@
+"""Named lintable entry points — the programs ``tools/cmn_lint.py`` (and
+the CI clean sweep) hold to zero error findings.
+
+Each entry point rebuilds the example's train step the way the example
+itself does — same builder (:func:`make_train_step` / the long-context
+jit), same loss structure, same donation — but at toy sizes, because the
+lint only reads the *schedule*: collective structure is invariant to
+width, so a 16-unit MLP proves the same theorem as the 1000-unit one at
+a fraction of the trace/compile cost.
+
+Everything here runs on the tier-1 CPU mesh: no TPU, no process spawn.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from chainermn_tpu.analysis.lint import LintReport, lint_step
+
+#: the seven communicator flavors the mnist sweep must hold clean
+#: (pure_nccl is the xla alias and is accepted as a spelling)
+MNIST_FLAVORS = ("naive", "flat", "hierarchical", "two_dimensional",
+                 "single_node", "non_cuda_aware", "xla")
+
+#: flavors whose decomposition needs a two-level topology on 8 devices
+_NEEDS_INTRA = {"hierarchical": 4, "two_dimensional": 4}
+
+
+def _mnist_target(flavor: str):
+    """The mnist example's step at toy width: MLP + multi-node Adam +
+    ``make_train_step(has_aux=True)`` (donating params/opt_state exactly
+    like the example's hot loop)."""
+    import chainermn_tpu
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.optimizers import init_opt_state, make_train_step
+
+    comm = chainermn_tpu.create_communicator(
+        flavor, intra_size=_NEEDS_INTRA.get(flavor))
+    model = MLP(16, 10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 784)))
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return loss, {"accuracy": acc}
+
+    step = make_train_step(comm, loss_fn, optimizer, has_aux=True)
+    batch = (jnp.zeros((comm.size * 4, 784), jnp.float32),
+             jnp.zeros((comm.size * 4,), jnp.int32))
+    return comm, step, (params, opt_state, batch), loss_fn
+
+
+def lint_mnist(flavors: Optional[Sequence[str]] = None,
+               rules: Optional[Sequence[str]] = None,
+               hlo: bool = True) -> List[LintReport]:
+    """One report per communicator flavor for the mnist step.  Every rule
+    runs: schedule-desync over two independent traces (every rank runs
+    this same builder, so identical traces ARE the invariant),
+    census-drift over the flavor's compiled allreduce, the gradient
+    probe over the example's loss, captured-constant/donation-alias/
+    async-pair over the traced + compiled step."""
+    reports = []
+    for flavor in (flavors or MNIST_FLAVORS):
+        comm, step, args, loss_fn = _mnist_target(flavor)
+        params, opt_state, batch = args
+        reports.append(lint_step(
+            step, *args,
+            name=f"examples/mnist[{flavor}]",
+            comm=comm, flavor=flavor,
+            loss=loss_fn, loss_args=(params, batch),
+            donate_argnums=(0, 1),
+            variants={"rank0": (step,) + args, "rank1": (step,) + args},
+            census=True, hlo=hlo, rules=rules,
+            raise_on_error=False))
+    return reports
+
+
+def _long_context_target():
+    """The long-context example's non-FSDP ring-attention step at toy
+    size: seq 128 over the 8-way ``sp`` mesh, loss traced through the
+    example's own shard_map (ppermute ring + explicit psums)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.utils import shard_map
+
+    devices = jax.devices()
+    n_sp = len(devices)
+    seq_len = 16 * n_sp
+    t_local = seq_len // n_sp
+    kw = dict(vocab=32, d_model=16, n_layers=1, n_heads=2,
+              max_len=seq_len)
+    model = TransformerLM(attention_impl="ring", axis_name="sp", **kw)
+    ref_init = TransformerLM(attention_impl="xla", **kw)
+    mesh = Mesh(np.array(devices[:n_sp]), ("sp",))
+    toks = jnp.zeros((2, seq_len), jnp.int32)
+    params = ref_init.init(jax.random.key(0), toks[:, :8])
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+
+    def sp_body(pp, tkk):
+        me = jax.lax.axis_index("sp")
+        logits = model.apply(pp, tkk, pos_offset=me * t_local)
+        nxt = jax.lax.ppermute(
+            tkk[:, :1], "sp",
+            perm=[(i, (i - 1) % n_sp) for i in range(n_sp)])
+        targets = jnp.concatenate([tkk[:, 1:], nxt], axis=1)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        mask = jnp.ones_like(ce).at[:, -1].set(
+            jnp.where(me == n_sp - 1, 0.0, 1.0))
+        total = jax.lax.psum((ce * mask).sum(), "sp")
+        count = jax.lax.psum(mask.sum(), "sp")
+        return total / count
+
+    def loss_fn(p_, tk):
+        return shard_map(sp_body, mesh=mesh,
+                         in_specs=(P(), P(None, "sp")),
+                         out_specs=P(), check_vma=False)(p_, tk)
+
+    @jax.jit
+    def step(p_, s_, tk):
+        l, g = jax.value_and_grad(loss_fn)(p_, tk)
+        updates, s_ = opt.update(g, s_, p_)
+        return optax.apply_updates(p_, updates), s_, l
+
+    return step, (params, opt_state, toks)
+
+
+def lint_long_context(rules: Optional[Sequence[str]] = None,
+                      hlo: bool = True) -> List[LintReport]:
+    """One report for the long-context ring-attention step.  No
+    communicator object is in play (the example drives shard_map
+    directly), so the comm-bound rules (census-drift, the gradient
+    probe) report as skipped; schedule-desync, captured-constant,
+    donation-alias, and async-pair all run."""
+    step, args = _long_context_target()
+    return [lint_step(
+        step, *args,
+        name="examples/long_context[ring]",
+        variants={"rank0": (step,) + args, "rank1": (step,) + args},
+        hlo=hlo, rules=rules, raise_on_error=False)]
+
+
+ENTRY_POINTS: Dict[str, dict] = {
+    "examples/mnist": {
+        "fn": lint_mnist,
+        "flavors": MNIST_FLAVORS,
+        "help": "MLP data-parallel step, one report per communicator "
+                "flavor (census + gradient probe + desync variants)",
+    },
+    "examples/long_context": {
+        "fn": lint_long_context,
+        "flavors": None,
+        "help": "ring-attention sequence-parallel LM step (schedule, "
+                "captured-constant, donation, async rules)",
+    },
+}
+
+
+def lint_entry_point(name: str, flavors: Optional[Sequence[str]] = None,
+                     rules: Optional[Sequence[str]] = None,
+                     hlo: bool = True) -> List[LintReport]:
+    """Run a named entry point's lint sweep, returning its reports."""
+    try:
+        entry = ENTRY_POINTS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown entry point {name!r}; available: "
+            f"{sorted(ENTRY_POINTS)}") from None
+    if entry["flavors"] is not None:
+        return entry["fn"](flavors=flavors, rules=rules, hlo=hlo)
+    if flavors:
+        raise ValueError(f"{name} takes no --flavors")
+    return entry["fn"](rules=rules, hlo=hlo)
+
+
+__all__ = ["ENTRY_POINTS", "MNIST_FLAVORS", "lint_entry_point",
+           "lint_long_context", "lint_mnist"]

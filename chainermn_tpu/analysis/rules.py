@@ -1,0 +1,437 @@
+"""cmn-lint rule registry — each rule proves one collective-schedule
+invariant at trace/compile time, on CPU, before a mesh is involved.
+
+Every rule has a **stable ID** (the contract for CI greps, findings
+JSON, and the docs catalog in ``docs/static_analysis.md``) and names, in
+its finding message, the runtime subsystem that would otherwise catch
+the bug only after a pod is wedged — the flight recorder / hang
+watchdog cross-link the tentpole asks for.
+
+Rules read a duck-typed context object (``LintContext`` in ``lint.py``;
+tests may pass any namespace with the same attributes).  A rule whose
+required inputs are absent is *skipped*, not failed — ``LintReport``
+records the reason.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+SEVERITIES = ("error", "warning", "info")
+
+#: numpy dtype name -> HLO shape dtype token (wire-dtype-mismatch rule)
+NP_TO_HLO_DTYPE = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "float8_e4m3fn": "f8e4m3fn",
+    "float8_e5m2": "f8e5m2", "int64": "s64", "int32": "s32",
+    "int16": "s16", "int8": "s8", "uint8": "u8", "bool": "pred",
+}
+
+
+@dataclass
+class Finding:
+    """One lint finding.  ``rule`` is the stable ID; ``target`` names the
+    linted program (entry point / flavor / function)."""
+    rule: str
+    severity: str
+    message: str
+    target: str = ""
+    details: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "target": self.target, "message": self.message,
+                "details": self.details}
+
+    def render(self) -> str:
+        head = f"[{self.severity}] {self.rule}"
+        if self.target:
+            head += f" ({self.target})"
+        return head + ": " + self.message
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    requires: tuple            # context attributes that must be non-None
+    fn: Callable               # fn(ctx) -> List[Finding]
+
+    def missing(self, ctx) -> List[str]:
+        return [r for r in self.requires
+                if getattr(ctx, r, None) is None]
+
+    def run(self, ctx) -> List[Finding]:
+        out = []
+        for f in self.fn(ctx):
+            f.rule = self.id
+            f.severity = f.severity or self.severity
+            f.target = f.target or getattr(ctx, "name", "") or ""
+            out.append(f)
+        return out
+
+
+_REGISTRY: "Dict[str, Rule]" = {}
+
+
+def rule(id: str, severity: str, summary: str, requires: tuple = ()):
+    assert severity in SEVERITIES, severity
+
+    def deco(fn):
+        _REGISTRY[id] = Rule(id=id, severity=severity, summary=summary,
+                             requires=requires, fn=fn)
+        return fn
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY.values())
+
+
+def get_rule(id: str) -> Rule:
+    try:
+        return _REGISTRY[id]
+    except KeyError:
+        raise ValueError(
+            f"unknown lint rule {id!r}; available: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def _finding(message: str, **details) -> Finding:
+    return Finding(rule="", severity="", message=message, details=details)
+
+
+# ---------------------------------------------------------------------------
+# schedule-desync — the static identify_desync
+# ---------------------------------------------------------------------------
+
+@rule("schedule-desync", "error",
+      "per-rank/per-config traced schedules must be identical",
+      requires=("variants",))
+def _schedule_desync(ctx) -> List[Finding]:
+    """Every rank traces the SAME Python; a branch on rank (or any
+    nondeterminism in trace order) gives two ranks different collective
+    schedules, and the mesh wedges at the first divergence.  This is the
+    static version of the flight recorder's ``identify_desync``: the
+    runtime analysis names the rank stuck behind after the hang — this
+    rule names the diverging op before anything runs."""
+    variants = ctx.variants      # dict label -> CollectiveSchedule
+    labels = sorted(variants)
+    if len(labels) < 2:
+        return []
+    base_label = labels[0]
+    base = variants[base_label]
+    out: List[Finding] = []
+    for other_label in labels[1:]:
+        d = base.diff(variants[other_label])
+        if d is None:
+            continue
+        out.append(_finding(
+            f"collective schedules diverge between {base_label!r} and "
+            f"{other_label!r} at op #{d['index']}: "
+            f"{base_label!r} issues {d['left'] or '<end of schedule>'}, "
+            f"{other_label!r} issues {d['right'] or '<end of schedule>'}. "
+            "On a live mesh this wedges every rank at that collective — "
+            "the hang the flight-recorder watchdog diagnoses at runtime "
+            "(docs/observability.md, identify_desync); fix the "
+            "rank/config-dependent trace so all ranks issue one schedule.",
+            index=d["index"], left=d["left"], right=d["right"],
+            left_label=base_label, right_label=other_label))
+        break  # first divergence is THE actionable one
+    return out
+
+
+# ---------------------------------------------------------------------------
+# census-drift — per-flavor expected decomposition
+# ---------------------------------------------------------------------------
+
+#: expected collective-kind sequence of each flavor's compiled
+#: ``allreduce_grad`` (the generalization of tests/test_census.py — the
+#: decomposition IS the flavor, so any drift is an error).  Values are
+#: functions of (inter_size) because degenerate single-host worlds
+#: collapse legs.
+def _flat_family(_inter):
+    return ("all-reduce",)
+
+
+def _hierarchical(inter):
+    return ("all-reduce", "all-reduce") if inter > 1 else ("all-reduce",)
+
+
+def _single_node(_inter):
+    # intra AR + the (possibly degenerate, singleton-groups) inter leg
+    return ("all-reduce", "all-reduce")
+
+
+def _two_dimensional(inter):
+    if inter > 1:
+        return ("reduce-scatter", "all-reduce", "all-reduce")
+    return ("reduce-scatter", "all-reduce")
+
+
+EXPECTED_DECOMPOSITION = {
+    "naive": _flat_family,
+    "flat": _flat_family,
+    "xla": _flat_family,
+    "pure_nccl": _flat_family,
+    "non_cuda_aware": _flat_family,
+    "single_node": _single_node,
+    "hierarchical": _hierarchical,
+    "two_dimensional": _two_dimensional,
+}
+
+
+def expected_kinds(flavor: str, inter_size: int = 1) -> tuple:
+    """Expected ``allreduce_grad`` collective-kind sequence for a
+    communicator flavor (shared with tests/test_census.py)."""
+    try:
+        return EXPECTED_DECOMPOSITION[flavor](inter_size)
+    except KeyError:
+        raise ValueError(
+            f"no expected decomposition for flavor {flavor!r}; known: "
+            f"{sorted(EXPECTED_DECOMPOSITION)}") from None
+
+
+@rule("census-drift", "error",
+      "compiled allreduce_grad decomposition must match the flavor's "
+      "expected census",
+      requires=("flavor", "census_schedule"))
+def _census_drift(ctx) -> List[Finding]:
+    flavor = ctx.flavor
+    inter = getattr(ctx, "inter_size", 1) or 1
+    want = expected_kinds(flavor, inter)
+    got = ctx.census_schedule.kinds()
+    if got == want:
+        return []
+    return [_finding(
+        f"communicator flavor {flavor!r} compiled allreduce_grad to "
+        f"{list(got) or '<no collectives>'} but its decomposition is "
+        f"specified as {list(want)} (inter_size={inter}).  The "
+        "decomposition IS the flavor (docs/performance.md census table; "
+        "CENSUS_r*.json artifact): drift here means a different wire "
+        "cost model and a schedule the other ranks do not expect.",
+        expected=list(want), observed=list(got), flavor=flavor,
+        inter_size=inter)]
+
+
+# ---------------------------------------------------------------------------
+# unpinned-transpose — the PR 1 bug class
+# ---------------------------------------------------------------------------
+
+@rule("unpinned-transpose", "error",
+      "a psum differentiated inside the SPMD body must pin its identity "
+      "transpose",
+      requires=("grad_probe",))
+def _unpinned_transpose(ctx) -> List[Finding]:
+    """A loss differentiated INSIDE the SPMD region (the
+    ``make_train_step`` shape) that allreduces a replicated value with a
+    raw ``psum`` gets the psum→psum transpose: the cotangent is summed
+    again and every gradient arrives inflated by ``size``.  The pinned
+    path (``chainermn_tpu.functions.allreduce``, a custom VJP whose
+    backward is the identity) adds NO backward psum — so any psum excess
+    of the grad trace over the primal trace, per axis set, is an
+    unpinned transpose."""
+    probe = ctx.grad_probe   # {"primal": schedule, "grad": schedule}
+    primal_counts = probe["primal"].counts_by_axes("psum")
+    grad_counts = probe["grad"].counts_by_axes("psum")
+    out: List[Finding] = []
+    for axes, n_grad in sorted(grad_counts.items()):
+        extra = n_grad - primal_counts.get(axes, 0)
+        if extra <= 0:
+            continue
+        ax_txt = ",".join(a for a in axes if a is not None) or "?"
+        out.append(_finding(
+            f"{extra} psum(s) over axes ({ax_txt}) appear in the "
+            f"backward trace of the per-rank loss but not in its primal "
+            f"trace: a psum's VJP was transposed to another psum, so "
+            f"gradients are inflated by the axis size.  Wrap the "
+            f"allreduce in chainermn_tpu.functions.allreduce (custom VJP "
+            f"pinning the identity transpose) instead of calling "
+            f"lax.psum/communicator.allreduce raw inside a loss that is "
+            f"differentiated in the SPMD body.  At runtime this is "
+            f"silent — no hang for the watchdog to catch, just a wrong "
+            f"effective learning rate.",
+            axes=list(ax_txt.split(",")), extra_backward_psums=extra,
+            primal_psums=primal_counts.get(axes, 0),
+            grad_psums=n_grad))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# captured-constant — the promoted utils/jaxpr_audit guard
+# ---------------------------------------------------------------------------
+
+@rule("captured-constant", "error",
+      "traced program must not close over large array constants",
+      requires=("closed_jaxpr",))
+def _captured_constant(ctx) -> List[Finding]:
+    from chainermn_tpu.analysis.captured import (
+        DEFAULT_MAX_BYTES, captured_constant_message, constants_in_jaxpr)
+
+    max_bytes = getattr(ctx, "max_const_bytes", None) or DEFAULT_MAX_BYTES
+    found = constants_in_jaxpr(ctx.closed_jaxpr, max_bytes=max_bytes)
+    if not found:
+        return []
+    label = getattr(ctx, "name", "") or "traced function"
+    return [_finding(
+        captured_constant_message(found, label, max_bytes),
+        constants=found, max_bytes=max_bytes)]
+
+
+# ---------------------------------------------------------------------------
+# donation-alias — donated buffers read through a second alias
+# ---------------------------------------------------------------------------
+
+@rule("donation-alias", "error",
+      "no argument buffer may alias a donated argument",
+      requires=("args", "donate_argnums"))
+def _donation_alias(ctx) -> List[Finding]:
+    """Two checks on the step's ACTUAL operands:
+
+    * the same device buffer passed through two argument positions while
+      at least one of them is donated — XLA will reuse the storage for
+      an output and the other alias reads freed/overwritten memory (or
+      jax raises mid-run, which on a pod means one rank dying inside a
+      collective: a hang everywhere else);
+    * the same error-feedback ``CompressionState`` leaf aliased into two
+      FSDP buckets — each bucket's reduce-scatter would accumulate its
+      residual into one buffer and silently corrupt the other's EF
+      stream.
+    """
+    import jax
+
+    out: List[Finding] = []
+    donated = set(ctx.donate_argnums or ())
+    if donated:
+        by_id: Dict[int, List[tuple]] = {}
+        for argno, arg in enumerate(ctx.args):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+                if not hasattr(leaf, "nbytes") or not hasattr(leaf, "shape"):
+                    continue
+                by_id.setdefault(id(leaf), []).append(
+                    (argno, jax.tree_util.keystr(path)))
+        for _leaf_id, sites in sorted(by_id.items()):
+            if len(sites) < 2:
+                continue
+            if not any(argno in donated for argno, _ in sites):
+                continue
+            where = ", ".join(f"arg{argno}{p}" for argno, p in sites)
+            out.append(_finding(
+                f"the same array object is passed at {where} while "
+                f"argument(s) {sorted({a for a, _ in sites if a in donated})} "
+                f"are donated: after donation the buffer belongs to the "
+                f"output and every other alias reads poisoned memory.  "
+                f"Pass an explicit copy, or stop donating that argument.",
+                positions=[{"arg": a, "path": p} for a, p in sites],
+                donated=sorted(donated)))
+    # EF-state aliasing across FSDP buckets
+    fsdp_state = getattr(ctx, "fsdp_state", None)
+    if fsdp_state is not None and getattr(fsdp_state, "comp", ()):
+        import jax
+
+        seen: Dict[int, int] = {}
+        for b, comp in enumerate(fsdp_state.comp):
+            if comp is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(comp):
+                if not hasattr(leaf, "nbytes"):
+                    continue
+                if id(leaf) in seen and seen[id(leaf)] != b:
+                    out.append(_finding(
+                        f"error-feedback state buffer is aliased into "
+                        f"buckets {seen[id(leaf)]} and {b}: each bucket's "
+                        f"compressed reduce-scatter feeds its residual "
+                        f"back into the shared buffer, corrupting the "
+                        f"other bucket's EF stream (convergence poison, "
+                        f"invisible to the runtime watchdog).  Give every "
+                        f"bucket its own CompressionState.",
+                        buckets=[seen[id(leaf)], b]))
+                seen.setdefault(id(leaf), b)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wire-dtype-mismatch — compression spec vs compiled collective dtype
+# ---------------------------------------------------------------------------
+
+@rule("wire-dtype-mismatch", "error",
+      "each FSDP bucket's compiled reduce-scatter must run in its "
+      "declared wire dtype",
+      requires=("fsdp_meta", "hlo_schedule"))
+def _wire_dtype_mismatch(ctx) -> List[Finding]:
+    """DynamiQ-class pipelines (PAPERS.md) add a whole mismatch family:
+    the bucket layout SAYS int8-with-EF but the compiled program moves
+    f32 (compression silently off: 4x the wire), or vice versa (numerics
+    silently narrowed).  Compare each bucket's declared wire dtype
+    against the multiset of compiled reduce-scatter dtypes."""
+    from chainermn_tpu.compression import resolve_compressor
+
+    meta = ctx.fsdp_meta
+    expected: List[tuple] = []       # (bucket, hlo dtype token, why)
+    for b, layout in enumerate(meta.buckets):
+        if getattr(layout, "compressor", None):
+            comp = resolve_compressor(layout.compressor)
+            wire = np.dtype(comp.wire_dtype_for(np.dtype("float32"))).name
+            expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
+                             f"compressor {comp.name!r}"))
+        elif getattr(layout, "wire_dtype", None):
+            wire = np.dtype(layout.wire_dtype).name
+            expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
+                             f"wire_dtype {wire!r}"))
+    if not expected:
+        return []
+    observed = [op.dtype for op in ctx.hlo_schedule
+                if op.kind == "reduce-scatter"]
+    remaining = list(observed)
+    out: List[Finding] = []
+    for b, token, why in expected:
+        if token in remaining:
+            remaining.remove(token)
+            continue
+        out.append(_finding(
+            f"bucket {b} declares {why} (wire dtype {token}) but no "
+            f"compiled reduce-scatter runs in {token} "
+            f"(observed reduce-scatter dtypes: {observed or 'none'}).  "
+            f"The checkpoint sidecar and resume guard trust the layout's "
+            f"spec — a program that moves a different dtype is either "
+            f"paying full-precision wire cost or silently narrowing "
+            f"numerics.",
+            bucket=b, expected_dtype=token, observed_dtypes=observed,
+            declared=why))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# async-pair — unmatched all-reduce-start/done in the compiled schedule
+# ---------------------------------------------------------------------------
+
+@rule("async-pair", "error",
+      "every async collective start must have a matching done",
+      requires=("hlo_schedule",))
+def _async_pair(ctx) -> List[Finding]:
+    out: List[Finding] = []
+    problems = list(ctx.hlo_schedule.problems)
+    census = getattr(ctx, "census_schedule", None)
+    if census is not None:
+        problems += list(census.problems)
+    for p in problems:
+        if not str(p.get("kind", "")).startswith("unmatched-async"):
+            continue
+        half = "start" if p["kind"].endswith("start") else "done"
+        out.append(_finding(
+            f"async collective {p.get('op')!r} ({p.get('name')}) has an "
+            f"unmatched -{half}: the compiled schedule "
+            f"{'issues a collective it never awaits' if half == 'start' else 'awaits a collective it never issued'}"
+            f" — on hardware that is a guaranteed wedge, the exact hang "
+            f"class the collective watchdog exists to catch at runtime "
+            f"(docs/observability.md).",
+            **p))
+    return out
+
+
+__all__ = ["EXPECTED_DECOMPOSITION", "Finding", "NP_TO_HLO_DTYPE", "Rule",
+           "SEVERITIES", "all_rules", "expected_kinds", "get_rule", "rule"]

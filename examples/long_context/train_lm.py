@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.models import TransformerLM
 from chainermn_tpu.utils import shard_map
-from chainermn_tpu.utils.jaxpr_audit import assert_no_captured_constants
+from chainermn_tpu.analysis import assert_no_captured_constants
 
 
 def make_motif_task(n, seq_len, vocab, motif_len=16, seed=0):

@@ -29,6 +29,17 @@ def devices():
     return devs[:8]
 
 
+@pytest.fixture
+def lint_step(devices):
+    """The cmn-lint one-liner as a fixture: ``lint_step(step, *args,
+    comm=..., ...)`` raises ``LintError`` on any error-severity finding
+    (pass ``raise_on_error=False`` to inspect the report instead) — see
+    docs/static_analysis.md."""
+    from chainermn_tpu.analysis import lint_step as _lint_step
+
+    return _lint_step
+
+
 def pytest_collection_modifyitems(config, items):
     """Keep the default gate correctness-only: deselect ``perf``-marked
     timing thresholds unless the user asked for them via ``-m`` or by

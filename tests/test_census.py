@@ -7,6 +7,12 @@ to be re-verified per round by command, not per doc edit: these tests pin
 the structure of each flavor's compiled allreduce_grad HLO on the
 8-device virtual mesh, and ``bench_allreduce.py --census`` emits the same
 parse as a committed JSON artifact (CENSUS_r05.json).
+
+Both this gate and the artifact now read collectives through the ONE
+shared parser, :mod:`chainermn_tpu.analysis.hlo` (they used to carry
+duplicate regexes in benchmarks/ that could drift apart), and the
+expected kind sequences come from the same table the ``census-drift``
+lint rule enforces.
 """
 
 import os
@@ -17,11 +23,7 @@ import jax.numpy as jnp
 import pytest
 
 import chainermn_tpu
-
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "benchmarks"))
-from bench_allreduce import _collective_ops  # noqa: E402
+from chainermn_tpu.analysis import collective_census, expected_kinds
 
 N_ELEMS = 1000  # ~4 KB fp32 — census is about structure, not size
 
@@ -35,7 +37,7 @@ def _ops_for(name, **kwargs):
     def body(g):
         return comm.allreduce_grad(g)
 
-    return _collective_ops(comm.compiled_hlo(body, stacked))
+    return collective_census(comm.compiled_hlo(body, stacked))
 
 
 @pytest.mark.parametrize("name", ["naive", "flat", "xla", "non_cuda_aware"])
@@ -43,6 +45,7 @@ def test_single_allreduce_flavors(name, devices):
     """Flat-family flavors compile to exactly ONE all-reduce over all 8
     devices (XLA's combiner merges naive's per-leaf psums by itself)."""
     ops = _ops_for(name)
+    assert tuple(o["op"] for o in ops) == expected_kinds(name), ops
     assert [o["op"] for o in ops] == ["all-reduce"], ops
     assert "{0,1,2,3,4,5,6,7}" in ops[0]["groups"], ops
 
@@ -51,7 +54,8 @@ def test_hierarchical_two_level(devices):
     """hierarchical = AR over the intra (ICI) axis then AR over the inter
     (DCN) axis — two collectives, full buffer each."""
     ops = _ops_for("hierarchical", intra_size=4)
-    assert [o["op"] for o in ops] == ["all-reduce", "all-reduce"], ops
+    assert tuple(o["op"] for o in ops) == expected_kinds(
+        "hierarchical", inter_size=2), ops
     groups = [o["groups"] for o in ops]
     assert any("{0,1,2,3}" in g for g in groups), groups   # intra leg
     assert any("{0,4}" in g for g in groups), groups       # inter leg
@@ -62,14 +66,32 @@ def test_two_dimensional_scatter_small_inter_leg(devices):
     shard + gather-back.  The inter (DCN) leg carrying only G/intra_size
     is the property that justifies the flavor's existence."""
     ops = _ops_for("two_dimensional", intra_size=4)
-    kinds = [o["op"] for o in ops]
-    assert kinds == ["reduce-scatter", "all-reduce", "all-reduce"], ops
+    kinds = tuple(o["op"] for o in ops)
+    assert kinds == expected_kinds("two_dimensional", inter_size=2), ops
+    assert kinds == ("reduce-scatter", "all-reduce", "all-reduce"), ops
     full = max(o["bytes"] for o in ops)
     inter = [o for o in ops if o["op"] == "all-reduce"
              and "{0,4}" in (o["groups"] or "")]
     assert inter, ops
     # the inter leg moves ~G/intra_size, not G (pad slop allowed)
     assert inter[0]["bytes"] <= full / 4 + 64, (inter, full)
+
+
+def test_bench_census_delegates_to_shared_parser(devices):
+    """``bench_allreduce._collective_ops`` (the artifact writer) is the
+    shared analysis parser — same records, byte for byte, so the gate and
+    the committed CENSUS artifact cannot drift apart again."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    from bench_allreduce import _collective_ops
+
+    comm = chainermn_tpu.create_communicator("xla")
+    stacked = jnp.zeros((comm.size, N_ELEMS), "float32")
+    hlo = comm.compiled_hlo(lambda g: comm.allreduce_grad(g), stacked)
+    assert _collective_ops(hlo) == collective_census(hlo)
+    assert all(set(o) >= {"op", "bytes", "groups"}
+               for o in _collective_ops(hlo))
 
 
 def test_census_artifact_matches_live_parse(devices):
@@ -102,7 +124,7 @@ def test_census_artifact_matches_live_parse(devices):
         def body(g, comm=comm):
             return comm.allreduce_grad(g)
 
-        live = _collective_ops(comm.compiled_hlo(body, stacked))
+        live = collective_census(comm.compiled_hlo(body, stacked))
         want = [(o["op"], o["groups"]) for o in entry["collectives"]]
         got = [(o["op"], o["groups"]) for o in live]
         assert got == want, (name, got, want)

@@ -57,7 +57,8 @@ def test_multichip_day1_dry_run():
     out = r.stdout
     for step in ("tpu_smoke", "convergence ledger", "allreduce scaling",
                  "combiner/barrier split", "five BASELINE configs",
-                 "ring attention", "multi-controller"):
+                 "ring attention", "multi-controller",
+                 "cmn-lint static preflight"):
         assert step in out, f"runbook lost its '{step}' step:\n{out}"
     assert out.count("DRY_RUN: not executed") >= 7, out
     assert "artifact:" in out

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""cmn-lint CLI — statically prove an entry point's collective schedules
+safe before they ever run.
+
+Lints a named example/benchmark entry point (the same build the example
+performs, at toy width) with every applicable rule from
+``chainermn_tpu.analysis`` and reports findings with stable rule IDs.
+Exit status is non-zero iff any error-severity finding fired, so this
+drops straight into CI and into ``tools/multichip_day1.sh``'s preflight:
+a schedule bug fails at submit time on a CPU host, not at step 40k on a
+v4 pod.
+
+Usage::
+
+    python tools/cmn_lint.py examples/mnist
+    python tools/cmn_lint.py examples/mnist --json --flavors xla,flat
+    python tools/cmn_lint.py examples/long_context --out lint.json
+    python tools/cmn_lint.py --list
+
+Rendered JSON feeds ``tools/obs_report.py --lint`` (the findings lane
+next to the flight timeline).  Rule catalog: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="trace-time SPMD static analyzer (cmn-lint)")
+    p.add_argument("entry", nargs="?",
+                   help="entry point to lint (see --list)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the findings document as JSON on stdout")
+    p.add_argument("--out", default=None,
+                   help="also write the findings JSON to this path "
+                        "(the obs_report --lint artifact)")
+    p.add_argument("--flavors", default=None,
+                   help="comma-separated communicator flavors "
+                        "(entry points that sweep flavors only; "
+                        "default: all seven)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--devices", type=int, default=8,
+                   help="minimum device count to lint over; hosts with "
+                        "fewer accelerators get a virtual CPU mesh of "
+                        "this size (default 8 — a single device makes "
+                        "every collective degenerate and the lint "
+                        "vacuous)")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="skip compiling the step (jaxpr-only rules; "
+                        "faster, but async-pair/wire-dtype need HLO)")
+    p.add_argument("--list", action="store_true", dest="list_entries",
+                   help="list entry points and rules, then exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if not args.list_entries:
+        # Real accelerators win; otherwise bring up a virtual CPU mesh so
+        # the linted schedules are the multi-device ones.  The CPU device
+        # count must be configured BEFORE the first backend exists (on
+        # jax < 0.5 it latches at first client creation and no reset can
+        # grow it), and the flag is harmless when a TPU backend wins.
+        from chainermn_tpu.utils import cpu_mesh
+        if cpu_mesh._backend_uninitialized():
+            cpu_mesh._set_cpu_device_flags(args.devices)
+        cpu_mesh.ensure_device_count(args.devices)
+
+    from chainermn_tpu.analysis import all_rules
+    from chainermn_tpu.analysis.entrypoints import (
+        ENTRY_POINTS, lint_entry_point)
+
+    if args.list_entries:
+        print("entry points:")
+        for name, entry in sorted(ENTRY_POINTS.items()):
+            print(f"  {name}: {entry['help']}")
+        print("rules:")
+        for r in all_rules():
+            print(f"  {r.id} [{r.severity}]: {r.summary}")
+        return 0
+    if not args.entry:
+        _build_parser().error("an entry point is required (see --list)")
+
+    flavors = args.flavors.split(",") if args.flavors else None
+    rules = args.rules.split(",") if args.rules else None
+    reports = lint_entry_point(args.entry, flavors=flavors, rules=rules,
+                               hlo=not args.no_hlo)
+
+    findings = [dict(f.as_dict()) for rep in reports for f in rep.findings]
+    doc = {
+        "suite": "cmn_lint",
+        "entry": args.entry,
+        "ok": all(rep.ok for rep in reports),
+        "findings": findings,
+        "reports": [rep.to_json() for rep in reports],
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for rep in reports:
+            print(rep.render_text())
+        n_err = sum(len(rep.errors) for rep in reports)
+        verdict = "CLEAN" if doc["ok"] else f"{n_err} ERROR FINDING(S)"
+        print(f"cmn-lint {args.entry}: {verdict} "
+              f"({len(reports)} target(s) linted)")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,18 @@ print("   knobs round-trip OK: " + " ".join(sorted(cfg.to_env())))
 PYEOF
 then echo "   OK"; else echo "   FAILED (continuing — record it)"; fi
 
+# ---- preflight: cmn-lint static schedule analysis ---------------------
+# Every hang class the watchdog above diagnoses at runtime is statically
+# visible before a step runs: lint the example entry points' collective
+# schedules (schedule-desync, census-drift, unpinned-transpose, ... —
+# docs/static_analysis.md) so a schedule bug fails HERE, on this host,
+# not at step 40k on the slice.  Needs zero TPU devices; the findings
+# JSON renders next to the flight timeline via `obs_report --lint`.
+run 0 "$OUT/CMN_LINT_$ROUND.json" \
+    "cmn-lint static preflight: prove every flavor's collective schedule safe before burning chip time" -- \
+    bash -c "$PY_TPU tools/cmn_lint.py examples/mnist --json \
+        --out '$OUT/CMN_LINT_$ROUND.json' > /dev/null"
+
 # ---- single-chip steps (run today, re-run on the slice for parity) ----
 
 run 1 "$OUT/TPU_EVIDENCE_$ROUND.json" \

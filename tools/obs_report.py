@@ -448,9 +448,56 @@ def flight_report(dumps: List[dict], max_events: int = 60) -> str:
     return "\n\n".join(p for p in parts if p)
 
 
+# ---------------------------------------------------------------------------
+# --lint: render a cmn-lint findings JSON next to the flight timeline
+# ---------------------------------------------------------------------------
+
+def load_lint_doc(path: str) -> Optional[dict]:
+    """Load a ``tools/cmn_lint.py --out`` findings document.  A directory
+    is globbed for ``CMN_LINT_*.json`` (the multichip_day1.sh artifact
+    name), newest taken."""
+    if os.path.isdir(path):
+        cands = sorted(glob.glob(os.path.join(path, "CMN_LINT_*.json")))
+        if not cands:
+            return None
+        path = cands[-1]
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("suite") != "cmn_lint":
+        print(f"warning: {path} is not a cmn_lint findings document",
+              file=sys.stderr)
+        return None
+    doc["_path"] = path
+    return doc
+
+
+def lint_section(doc: dict) -> str:
+    """Static-analysis lane: the trace-time verdict that complements the
+    runtime flight timeline — what cmn-lint proved (or flagged) about the
+    collective schedules BEFORE this run (docs/static_analysis.md)."""
+    findings = doc.get("findings", [])
+    reports = doc.get("reports", [])
+    n_err = sum(1 for f in findings if f.get("severity") == "error")
+    verdict = "CLEAN" if doc.get("ok") else f"{n_err} ERROR FINDING(S)"
+    head = (f"cmn-lint static analysis ({doc.get('entry', '?')}: {verdict}, "
+            f"{len(reports)} target(s) — {doc.get('_path', '')})")
+    if not findings:
+        skipped = sorted({r for rep in reports
+                          for r in (rep.get("skipped") or {})})
+        tail = (f"\nrules skipped everywhere: {', '.join(skipped)}"
+                if skipped else "")
+        return head + "\nno findings — every linted schedule proved safe" \
+            + tail
+    rows = [[f.get("severity", "?"), f.get("rule", "?"),
+             f.get("target", "-"),
+             " ".join(str(f.get("message", "")).split())[:72]]
+            for f in findings]
+    return head + "\n" + _table(["sev", "rule", "target", "finding"], rows)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("path", nargs="+",
+    ap.add_argument("path", nargs="*",
                     help="metrics JSONL file, or (with --flight) "
                          "flight_*.json dump files / a directory of them")
     ap.add_argument("--section", choices=sorted(SECTIONS),
@@ -464,7 +511,21 @@ def main(argv=None) -> int:
     ap.add_argument("--events", type=int, default=60, metavar="N",
                     help="max merged timeline events to print "
                          "(--flight mode, default 60)")
+    ap.add_argument("--lint", metavar="PATH", default=None,
+                    help="render a cmn-lint findings JSON (tools/"
+                         "cmn_lint.py --out; a directory is globbed for "
+                         "CMN_LINT_*.json) — alone, or as the static-"
+                         "analysis lane after the --flight report")
     args = ap.parse_args(argv)
+
+    lint_out = None
+    if args.lint:
+        doc = load_lint_doc(args.lint)
+        if doc is None:
+            print(f"no cmn_lint findings document at {args.lint}",
+                  file=sys.stderr)
+            return 1
+        lint_out = lint_section(doc)
 
     if args.flight:
         dumps = load_flight_dumps(args.path)
@@ -472,8 +533,17 @@ def main(argv=None) -> int:
             print(f"no flight dumps found in {' '.join(args.path)}",
                   file=sys.stderr)
             return 1
-        print(flight_report(dumps, max_events=args.events))
+        out = flight_report(dumps, max_events=args.events)
+        if lint_out:
+            out += "\n\n" + lint_out
+        print(out)
         return 0
+
+    if lint_out is not None and not args.path:
+        print(lint_out)
+        return 0
+    if not args.path:
+        ap.error("a metrics JSONL path is required (or --lint/--flight)")
 
     from chainermn_tpu.observability import read_jsonl
 
@@ -485,7 +555,10 @@ def main(argv=None) -> int:
         args.section = "compression"
     names = [args.section] if args.section else \
         ["steps", "collectives", "straggler", "bench", "compression"]
-    print("\n\n".join(SECTIONS[n](records) for n in names))
+    out = "\n\n".join(SECTIONS[n](records) for n in names)
+    if lint_out:
+        out += "\n\n" + lint_out
+    print(out)
     return 0
 
 
