@@ -56,6 +56,25 @@ def main():
                              "JSON file — the committed artifact form of "
                              "docs/performance.md's 'measured collective "
                              "structure' table")
+    parser.add_argument("--plan", metavar="PLAN.json", default=None,
+                        help="also benchmark this explicit plan file "
+                             "(chainermn_tpu.planner.Plan JSON) through "
+                             "the plan compiler, reported as "
+                             "'plan:<name>'")
+    parser.add_argument("--sweep", metavar="OUT.json", default=None,
+                        help="instead of the single-size flavor timing, "
+                             "sweep every candidate plan "
+                             "(planner.candidate_plans) across the "
+                             "--sweep-sizes-kb ladder and write "
+                             "machine-readable rows (schema "
+                             "allreduce_sweep/v1: {topology, dtype, "
+                             "bytes, plan, us, plan_spec}) for the "
+                             "autotuner (planner.autotune_from_rows / "
+                             "tools/perf_gate.py --planner)")
+    parser.add_argument("--sweep-sizes-kb", default="4,64,1024,16384",
+                        help="comma-separated payload sizes in KiB for "
+                             "--sweep (one rung per autotuner bucket by "
+                             "default)")
     args = parser.parse_args()
 
     import jax
@@ -86,6 +105,8 @@ def main():
 
     if args.census:
         return _census(args)
+    if args.sweep:
+        return _sweep(args)
 
     if args.scaling:
         counts = [c for c in (2 ** k for k in range(1, 12))
@@ -96,12 +117,20 @@ def main():
         counts = [len(all_devices)]
 
     n_elems = int(args.mb * (1 << 20) / np.dtype(args.dtype).itemsize)
+    names = args.communicators.split(",")
+    plan_obj = None
+    if args.plan:
+        from chainermn_tpu.planner import load_plan
+
+        plan_obj = load_plan(args.plan)
+        names.append(f"plan:{plan_obj.name}")
     results = []
     base_busbw = {}
-    for name in args.communicators.split(","):
+    for name in names:
       for count in counts:
+        flavor = "naive" if name.startswith("plan:") else name
         kwargs = {}
-        if args.allreduce_grad_dtype and name in ("xla", "pure_nccl"):
+        if args.allreduce_grad_dtype and flavor in ("xla", "pure_nccl"):
             kwargs["allreduce_grad_dtype"] = args.allreduce_grad_dtype
         if not args.scaling and args.intra_size is not None:
             kwargs["intra_size"] = args.intra_size
@@ -109,7 +138,7 @@ def main():
             if args.scaling:
                 kwargs["topology"] = init_topology(
                     devices=pick(count), intra_size=args.intra_size)
-            comm = chainermn_tpu.create_communicator(name, **kwargs)
+            comm = chainermn_tpu.create_communicator(flavor, **kwargs)
         except ValueError as e:
             # e.g. hierarchical on a 2-device world with intra=2
             # (inter=1), or an intra_size that doesn't divide this count
@@ -120,31 +149,20 @@ def main():
         stacked = jnp.tile(
             jnp.arange(n, dtype=args.dtype).reshape(n, 1), (1, n_elems))
 
-        def body(g):
-            return comm.allreduce_grad(g)
+        if name.startswith("plan:"):
+            from chainermn_tpu.planner import execute_plan
+
+            def body(g, comm=comm):
+                return execute_plan(plan_obj, comm, g)
+        else:
+            def body(g, comm=comm):
+                return comm.allreduce_grad(g)
 
         out = comm.run_spmd(body, stacked)     # compile + correctness
         expect = (n - 1) / 2.0
         np.testing.assert_allclose(
             np.asarray(out[0, :3]), expect, rtol=1e-2)
-        # Per-iteration sync on CPU: piled-up async multi-device executions
-        # can starve XLA's in-process collective rendezvous on few-core hosts.
-        sync_each = jax.default_backend() == "cpu"
-        # A value read is the timing fence: block_until_ready alone can
-        # return early on the tunneled TPU platform in this image.
-        fence = lambda o: float(jnp.sum(o[:, :1]))
-        for _ in range(args.warmup):
-            out = comm.run_spmd(body, stacked)
-            if sync_each:
-                jax.block_until_ready(out)
-        fence(out)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            out = comm.run_spmd(body, stacked)
-            if sync_each:
-                jax.block_until_ready(out)
-        fence(out)
-        dt = (time.perf_counter() - t0) / args.iters
+        dt = _time_spmd(comm, body, stacked, args.iters, args.warmup)
         payload = n_elems * np.dtype(args.dtype).itemsize
         busbw = 2 * (n - 1) / n * payload / dt / 1e9
         row = {"communicator": name, "devices": n,
@@ -173,6 +191,98 @@ def main():
                   f"{row['time_ms']} ms, {row['busbw_gbps']} GB/s bus",
                   file=sys.stderr)
     return results
+
+
+def _time_spmd(comm, body, stacked, iters, warmup):
+    """Time ``comm.run_spmd(body, stacked)``; returns seconds/iteration.
+
+    Caller has already run once for compile + correctness.  Shared by the
+    flavor timing loop and the --sweep plan grid so both report numbers
+    from the same clock discipline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # Per-iteration sync on CPU: piled-up async multi-device executions
+    # can starve XLA's in-process collective rendezvous on few-core hosts.
+    sync_each = jax.default_backend() == "cpu"
+    # A value read is the timing fence: block_until_ready alone can
+    # return early on the tunneled TPU platform in this image.
+    fence = lambda o: float(jnp.sum(o[:, :1]))
+    out = stacked
+    for _ in range(warmup):
+        out = comm.run_spmd(body, stacked)
+        if sync_each:
+            jax.block_until_ready(out)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = comm.run_spmd(body, stacked)
+        if sync_each:
+            jax.block_until_ready(out)
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _sweep(args):
+    """--sweep: time every candidate plan across a message-size ladder and
+    emit the stable machine-readable schema the autotuner consumes
+    (``allreduce_sweep/v1`` rows: {topology, dtype, bytes, plan, us},
+    plus plan_spec so the table can reconstruct non-flavor plans).
+
+    Feed the output to ``tools/perf_gate.py --planner`` to build the
+    on-disk plan table and verify the tuned selection beats the best
+    single fixed flavor.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.planner import (
+        SWEEP_SCHEMA, candidate_plans, execute_plan, load_plan)
+
+    kwargs = {}
+    if args.intra_size is not None:
+        kwargs["intra_size"] = args.intra_size
+    comm = chainermn_tpu.create_communicator("naive", **kwargs)
+    topo = comm.plan_topology()
+    n = comm.size
+    plans = list(candidate_plans(topo))
+    if args.plan:
+        plans.append(load_plan(args.plan))
+    rows = []
+    for kb in (float(s) for s in args.sweep_sizes_kb.split(",")):
+        n_elems = max(int(kb * 1024 / np.dtype(args.dtype).itemsize), 1)
+        payload = n_elems * np.dtype(args.dtype).itemsize
+        stacked = jnp.tile(
+            jnp.arange(n, dtype=args.dtype).reshape(n, 1), (1, n_elems))
+        for plan in plans:
+            def body(g, plan=plan):
+                return execute_plan(plan, comm, g)
+
+            out = comm.run_spmd(body, stacked)   # compile + correctness
+            np.testing.assert_allclose(
+                np.asarray(out[0, :3]), (n - 1) / 2.0, rtol=1e-2)
+            dt = _time_spmd(comm, body, stacked, args.iters, args.warmup)
+            row = {"topology": topo.key(), "dtype": args.dtype,
+                   "bytes": payload, "plan": plan.name,
+                   "us": round(dt * 1e6, 3),
+                   "plan_spec": plan.to_dict()}
+            rows.append(row)
+            print(f"sweep {plan.name:>24} @ {payload:>12} B: "
+                  f"{row['us']} us", file=sys.stderr)
+    doc = {"schema": SWEEP_SCHEMA,
+           "backend": jax.default_backend(),
+           "n_devices": n,
+           "topology": topo.key(),
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "rows": rows}
+    with open(args.sweep, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"rows": len(rows), "plans": len(plans),
+                      "topology": topo.key()}), flush=True)
+    return doc
 
 
 def _collective_ops(hlo_text):

@@ -36,6 +36,16 @@ _EXPORTS = {
     "LintReport": "chainermn_tpu.analysis",
     "extract_schedule": "chainermn_tpu.analysis",
     "CollectiveSchedule": "chainermn_tpu.analysis",
+    # collective planner (beyond-reference subsystem; ROADMAP item 1)
+    "Plan": "chainermn_tpu.planner",
+    "PlanTable": "chainermn_tpu.planner",
+    "PlanTopology": "chainermn_tpu.planner",
+    "Stage": "chainermn_tpu.planner",
+    "autotune_from_rows": "chainermn_tpu.planner",
+    "candidate_plans": "chainermn_tpu.planner",
+    "execute_plan": "chainermn_tpu.planner",
+    "flavor_plan": "chainermn_tpu.planner",
+    "plan_census_kinds": "chainermn_tpu.planner",
     # gradient compression wires (beyond-reference subsystem)
     "Compressor": "chainermn_tpu.compression",
     "NoCompression": "chainermn_tpu.compression",

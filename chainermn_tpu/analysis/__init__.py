@@ -32,7 +32,6 @@ from chainermn_tpu.analysis.lint import (
     lint_step,
 )
 from chainermn_tpu.analysis.rules import (
-    EXPECTED_DECOMPOSITION,
     Finding,
     all_rules,
     expected_kinds,
@@ -50,7 +49,7 @@ from chainermn_tpu.analysis.schedule import (
 __all__ = [
     "COLLECTIVE_PRIMITIVES", "CapturedConstantError",
     "CollectiveOp", "CollectiveSchedule", "DEFAULT_MAX_BYTES",
-    "EXPECTED_DECOMPOSITION", "Finding", "HloCollective", "HloParse",
+    "Finding", "HloCollective", "HloParse",
     "LintContext", "LintError", "LintReport", "all_rules",
     "allreduce_hlo", "assert_no_captured_constants", "build_grad_probe",
     "collective_census", "expected_kinds", "extract_schedule",

@@ -97,7 +97,7 @@ class LintContext:
     """
 
     def __init__(self, fn, args, kwargs, *, name="", comm=None, flavor=None,
-                 inter_size=None, loss=None, loss_args=None,
+                 inter_size=None, plan=None, loss=None, loss_args=None,
                  donate_argnums=(), fsdp_meta=None, fsdp_state=None,
                  variants=None, census=False, hlo=True,
                  max_const_bytes=DEFAULT_MAX_BYTES):
@@ -106,7 +106,13 @@ class LintContext:
         self.kwargs = kwargs or {}
         self.name = name or getattr(fn, "__name__", "") or "step"
         self.comm = comm
-        self.flavor = flavor
+        # an explicit plan is a first-class census/wire spec
+        # (census-drift and wire-dtype-mismatch read it); when only a
+        # communicator is given its flavor names the spec instead
+        self.plan = plan
+        self.flavor = (flavor if flavor is not None
+                       else getattr(comm, "flavor", None)
+                       if plan is None else flavor)
         self.inter_size = (inter_size if inter_size is not None
                            else getattr(comm, "inter_size", 1) or 1)
         self.loss = loss
@@ -292,7 +298,7 @@ def build_grad_probe(comm, loss, loss_args, label: str = "") \
 
 
 def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
-              loss=None, loss_args=None, donate_argnums=(),
+              plan=None, loss=None, loss_args=None, donate_argnums=(),
               fsdp_meta=None, fsdp_state=None, variants=None,
               census: bool = False, hlo: bool = True,
               max_const_bytes: int = DEFAULT_MAX_BYTES,
@@ -308,7 +314,8 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
     :class:`LintError` on error findings unless ``raise_on_error=False``.
     """
     ctx = LintContext(fn, args, kwargs, name=name, comm=comm, flavor=flavor,
-                      inter_size=inter_size, loss=loss, loss_args=loss_args,
+                      inter_size=inter_size, plan=plan,
+                      loss=loss, loss_args=loss_args,
                       donate_argnums=donate_argnums, fsdp_meta=fsdp_meta,
                       fsdp_state=fsdp_state, variants=variants,
                       census=census, hlo=hlo,

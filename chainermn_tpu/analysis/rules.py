@@ -15,6 +15,7 @@ records the reason.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -60,10 +61,18 @@ class Rule:
     summary: str
     requires: tuple            # context attributes that must be non-None
     fn: Callable               # fn(ctx) -> List[Finding]
+    #: attributes of which AT LEAST ONE must be non-None (e.g. a rule
+    #: that reads either a hand-declared spec or a plan-derived one)
+    requires_any: tuple = ()
 
     def missing(self, ctx) -> List[str]:
-        return [r for r in self.requires
-                if getattr(ctx, r, None) is None]
+        out = [r for r in self.requires
+               if getattr(ctx, r, None) is None]
+        if self.requires_any and not any(
+                getattr(ctx, r, None) is not None
+                for r in self.requires_any):
+            out.append(" or ".join(self.requires_any))
+        return out
 
     def run(self, ctx) -> List[Finding]:
         out = []
@@ -78,12 +87,14 @@ class Rule:
 _REGISTRY: "Dict[str, Rule]" = {}
 
 
-def rule(id: str, severity: str, summary: str, requires: tuple = ()):
+def rule(id: str, severity: str, summary: str, requires: tuple = (),
+         requires_any: tuple = ()):
     assert severity in SEVERITIES, severity
 
     def deco(fn):
         _REGISTRY[id] = Rule(id=id, severity=severity, summary=summary,
-                             requires=requires, fn=fn)
+                             requires=requires, requires_any=requires_any,
+                             fn=fn)
         return fn
     return deco
 
@@ -146,75 +157,67 @@ def _schedule_desync(ctx) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# census-drift — per-flavor expected decomposition
+# census-drift — per-flavor expected decomposition, DERIVED from the plan
 # ---------------------------------------------------------------------------
-
-#: expected collective-kind sequence of each flavor's compiled
-#: ``allreduce_grad`` (the generalization of tests/test_census.py — the
-#: decomposition IS the flavor, so any drift is an error).  Values are
-#: functions of (inter_size) because degenerate single-host worlds
-#: collapse legs.
-def _flat_family(_inter):
-    return ("all-reduce",)
-
-
-def _hierarchical(inter):
-    return ("all-reduce", "all-reduce") if inter > 1 else ("all-reduce",)
-
-
-def _single_node(_inter):
-    # intra AR + the (possibly degenerate, singleton-groups) inter leg
-    return ("all-reduce", "all-reduce")
-
-
-def _two_dimensional(inter):
-    if inter > 1:
-        return ("reduce-scatter", "all-reduce", "all-reduce")
-    return ("reduce-scatter", "all-reduce")
-
-
-EXPECTED_DECOMPOSITION = {
-    "naive": _flat_family,
-    "flat": _flat_family,
-    "xla": _flat_family,
-    "pure_nccl": _flat_family,
-    "non_cuda_aware": _flat_family,
-    "single_node": _single_node,
-    "hierarchical": _hierarchical,
-    "two_dimensional": _two_dimensional,
-}
-
 
 def expected_kinds(flavor: str, inter_size: int = 1) -> tuple:
     """Expected ``allreduce_grad`` collective-kind sequence for a
-    communicator flavor (shared with tests/test_census.py)."""
-    try:
-        return EXPECTED_DECOMPOSITION[flavor](inter_size)
-    except KeyError:
-        raise ValueError(
-            f"no expected decomposition for flavor {flavor!r}; known: "
-            f"{sorted(EXPECTED_DECOMPOSITION)}") from None
+    communicator flavor (shared with tests/test_census.py).
+
+    Derived, not maintained: the flavor's fixed plan
+    (``planner.plans.flavor_plan``) is compiled statically against an
+    (inter, intra) topology and the census read off the IR
+    (``planner.compiler.plan_census_kinds``) — the same IR the live
+    lowering executes, so this table cannot drift from the code.  The
+    pre-planner hand-written table survives only as the one-time
+    cross-check inside ``tests/test_census.py`` (where its ``inter == 1``
+    branches are documented as having been *wrong* against compiled
+    reality — XLA keeps singleton-group collectives).
+    """
+    from chainermn_tpu.planner.compiler import plan_census_kinds
+    from chainermn_tpu.planner.ir import PlanTopology
+    from chainermn_tpu.planner.plans import flavor_plan
+    plan = flavor_plan(flavor)  # raises ValueError on unknown flavors
+    # kinds depend on which scopes HAVE axes, not on axis sizes; the
+    # standard (inter, intra) mesh always declares both axes
+    topo = PlanTopology(axes=(("inter", max(int(inter_size or 1), 1)),
+                              ("intra", 1)))
+    return plan_census_kinds(plan, topo)
 
 
 @rule("census-drift", "error",
       "compiled allreduce_grad decomposition must match the flavor's "
-      "expected census",
-      requires=("flavor", "census_schedule"))
+      "plan-derived census",
+      requires=("census_schedule",), requires_any=("flavor", "plan"))
 def _census_drift(ctx) -> List[Finding]:
-    flavor = ctx.flavor
     inter = getattr(ctx, "inter_size", 1) or 1
-    want = expected_kinds(flavor, inter)
+    plan = getattr(ctx, "plan", None)
+    flavor = getattr(ctx, "flavor", None)
+    if plan is not None:
+        # explicit plan spec (e.g. an autotuned table entry) — derive
+        # the census against the communicator's declared topology
+        from chainermn_tpu.planner.compiler import plan_census_kinds
+        from chainermn_tpu.planner.ir import PlanTopology
+        comm = getattr(ctx, "comm", None)
+        topo = (comm.plan_topology() if comm is not None else
+                PlanTopology(axes=(("inter", inter), ("intra", 1))))
+        want = plan_census_kinds(plan, topo)
+        spec_name = f"plan {plan.name!r}"
+    else:
+        want = expected_kinds(flavor, inter)
+        spec_name = f"flavor {flavor!r}"
     got = ctx.census_schedule.kinds()
     if got == want:
         return []
     return [_finding(
-        f"communicator flavor {flavor!r} compiled allreduce_grad to "
+        f"communicator {spec_name} compiled allreduce_grad to "
         f"{list(got) or '<no collectives>'} but its decomposition is "
         f"specified as {list(want)} (inter_size={inter}).  The "
         "decomposition IS the flavor (docs/performance.md census table; "
         "CENSUS_r*.json artifact): drift here means a different wire "
         "cost model and a schedule the other ranks do not expect.",
-        expected=list(want), observed=list(got), flavor=flavor,
+        expected=list(want), observed=list(got),
+        flavor=flavor or (plan.name if plan is not None else None),
         inter_size=inter)]
 
 
@@ -359,49 +362,92 @@ def _donation_alias(ctx) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 @rule("wire-dtype-mismatch", "error",
-      "each FSDP bucket's compiled reduce-scatter must run in its "
-      "declared wire dtype",
-      requires=("fsdp_meta", "hlo_schedule"))
+      "compiled collectives must run in their declared wire dtypes "
+      "(FSDP bucket layouts and plan specs)",
+      requires=("hlo_schedule",), requires_any=("fsdp_meta", "plan"))
 def _wire_dtype_mismatch(ctx) -> List[Finding]:
     """DynamiQ-class pipelines (PAPERS.md) add a whole mismatch family:
-    the bucket layout SAYS int8-with-EF but the compiled program moves
-    f32 (compression silently off: 4x the wire), or vice versa (numerics
-    silently narrowed).  Compare each bucket's declared wire dtype
-    against the multiset of compiled reduce-scatter dtypes."""
+    the spec SAYS int8-with-EF but the compiled program moves f32
+    (compression silently off: 4x the wire), or vice versa (numerics
+    silently narrowed).  Two spec sources:
+
+    * an FSDP bucket layout — each bucket's declared wire dtype must
+      appear among the compiled reduce-scatter dtypes (one per bucket);
+    * a collective :class:`~chainermn_tpu.planner.ir.Plan` — the plan's
+      (or a stage's) wire dtype must appear among the compiled
+      collective dtypes.
+    """
     from chainermn_tpu.compression import resolve_compressor
 
-    meta = ctx.fsdp_meta
-    expected: List[tuple] = []       # (bucket, hlo dtype token, why)
-    for b, layout in enumerate(meta.buckets):
-        if getattr(layout, "compressor", None):
-            comp = resolve_compressor(layout.compressor)
-            wire = np.dtype(comp.wire_dtype_for(np.dtype("float32"))).name
-            expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
-                             f"compressor {comp.name!r}"))
-        elif getattr(layout, "wire_dtype", None):
-            wire = np.dtype(layout.wire_dtype).name
-            expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
-                             f"wire_dtype {wire!r}"))
-    if not expected:
-        return []
-    observed = [op.dtype for op in ctx.hlo_schedule
-                if op.kind == "reduce-scatter"]
-    remaining = list(observed)
     out: List[Finding] = []
-    for b, token, why in expected:
-        if token in remaining:
-            remaining.remove(token)
-            continue
-        out.append(_finding(
-            f"bucket {b} declares {why} (wire dtype {token}) but no "
-            f"compiled reduce-scatter runs in {token} "
-            f"(observed reduce-scatter dtypes: {observed or 'none'}).  "
-            f"The checkpoint sidecar and resume guard trust the layout's "
-            f"spec — a program that moves a different dtype is either "
-            f"paying full-precision wire cost or silently narrowing "
-            f"numerics.",
-            bucket=b, expected_dtype=token, observed_dtypes=observed,
-            declared=why))
+    meta = getattr(ctx, "fsdp_meta", None)
+    if meta is not None:
+        expected: List[tuple] = []       # (bucket, hlo dtype token, why)
+        for b, layout in enumerate(meta.buckets):
+            if getattr(layout, "compressor", None):
+                comp = resolve_compressor(layout.compressor)
+                wire = np.dtype(
+                    comp.wire_dtype_for(np.dtype("float32"))).name
+                expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
+                                 f"compressor {comp.name!r}"))
+            elif getattr(layout, "wire_dtype", None):
+                wire = np.dtype(layout.wire_dtype).name
+                expected.append((b, NP_TO_HLO_DTYPE.get(wire, wire),
+                                 f"wire_dtype {wire!r}"))
+        observed = [op.dtype for op in ctx.hlo_schedule
+                    if op.kind == "reduce-scatter"]
+        remaining = list(observed)
+        for b, token, why in expected:
+            if token in remaining:
+                remaining.remove(token)
+                continue
+            out.append(_finding(
+                f"bucket {b} declares {why} (wire dtype {token}) but no "
+                f"compiled reduce-scatter runs in {token} "
+                f"(observed reduce-scatter dtypes: {observed or 'none'}).  "
+                f"The checkpoint sidecar and resume guard trust the "
+                f"layout's spec — a program that moves a different dtype "
+                f"is either paying full-precision wire cost or silently "
+                f"narrowing numerics.",
+                bucket=b, expected_dtype=token, observed_dtypes=observed,
+                declared=why))
+    plan = getattr(ctx, "plan", None)
+    if plan is not None:
+        wires = []                       # (hlo dtype token, why)
+        if getattr(plan, "wire_dtype", None):
+            wire = np.dtype(plan.wire_dtype).name
+            wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
+                          f"plan {plan.name!r} wire_dtype {wire!r}"))
+        for i, st in enumerate(getattr(plan, "stages", ()) or ()):
+            if getattr(st, "wire_dtype", None):
+                wire = np.dtype(st.wire_dtype).name
+                wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
+                              f"plan {plan.name!r} stage {i} ({st.op}) "
+                              f"wire_dtype {wire!r}"))
+        observed = [op.dtype for op in ctx.hlo_schedule
+                    if op.kind in ("all-reduce", "reduce-scatter",
+                                   "all-gather", "collective-permute")]
+        # CPU XLA promotes bf16 collectives to f32 (the wire casts fuse
+        # AROUND the all-reduce), so on the lint preflight host the wire
+        # dtype may never appear ON a collective even when the cast seam
+        # is compiled in.  Accept the dtype appearing anywhere in the
+        # program as evidence the seam exists — a plan whose wire dtype
+        # was silently dropped has NO trace of it at all.
+        text = getattr(ctx, "hlo_text", None) or ""
+        for token, why in wires:
+            if token in observed:
+                continue
+            if re.search(rf"(?<!\w){re.escape(token)}\[", text):
+                continue
+            out.append(_finding(
+                f"{why} (HLO dtype {token}) but no compiled collective "
+                f"runs in {token} (observed collective dtypes: "
+                f"{observed or 'none'}).  A plan whose wire dtype the "
+                f"program does not move is either paying full-precision "
+                f"wire cost or silently narrowing numerics — the same "
+                f"trust contract as the FSDP layout spec.",
+                expected_dtype=token, observed_dtypes=observed,
+                declared=why))
     return out
 
 
@@ -433,5 +479,5 @@ def _async_pair(ctx) -> List[Finding]:
     return out
 
 
-__all__ = ["EXPECTED_DECOMPOSITION", "Finding", "NP_TO_HLO_DTYPE", "Rule",
+__all__ = ["Finding", "NP_TO_HLO_DTYPE", "Rule",
            "SEVERITIES", "all_rules", "expected_kinds", "get_rule", "rule"]

@@ -23,6 +23,7 @@ from chainermn_tpu.communicators.two_dimensional_communicator import TwoDimensio
 from chainermn_tpu.communicators.single_node_communicator import SingleNodeCommunicator
 from chainermn_tpu.communicators.non_cuda_aware_communicator import NonCudaAwareCommunicator
 from chainermn_tpu.communicators.xla_communicator import XlaCommunicator
+from chainermn_tpu.communicators.auto_communicator import AutoCommunicator
 
 _COMMUNICATORS = {
     "naive": NaiveCommunicator,
@@ -33,6 +34,9 @@ _COMMUNICATORS = {
     "non_cuda_aware": NonCudaAwareCommunicator,
     "xla": XlaCommunicator,
     "pure_nccl": XlaCommunicator,  # reference name -> TPU data plane
+    # tuned flavor: per-message-size plans from an autotuned plan table
+    # (create_communicator("auto", plan_table="plan_table.json"))
+    "auto": AutoCommunicator,
 }
 
 
@@ -55,6 +59,11 @@ def create_communicator(
     ``allreduce_grad_dtype`` knob (same 'xla'-only restriction); the
     quantizers (``"int8"``, ``"fp8"``) work with every flavor because
     they ride the generic pack/psum path.
+
+    The TPU-native extra name ``"auto"`` is the tuned flavor: pass
+    ``plan_table=`` (path / dict / ``planner.PlanTable``) and each
+    ``allreduce_grad`` runs the autotuned plan for its message size —
+    see ``docs/collective_planner.md``.
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -89,5 +98,6 @@ __all__ = [
     "SingleNodeCommunicator",
     "NonCudaAwareCommunicator",
     "XlaCommunicator",
+    "AutoCommunicator",
     "create_communicator",
 ]

@@ -16,7 +16,10 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 
 class FlatCommunicator(MeshCommunicator):
-    def _allreduce_grad_traced(self, grads):
+    flavor = "flat"
+
+    def _legacy_allreduce_grad_traced(self, grads):
+        # pre-planner lowering, kept as the census-parity reference
         buffers, meta = _packing.pack(grads)
         ax = self._axis_arg()
         buffers = [lax.psum(b, ax) for b in buffers]

@@ -19,14 +19,19 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 
 class HierarchicalCommunicator(MeshCommunicator):
+    flavor = "hierarchical"
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if len(self._data_axes) < 2:
+        # group-size inference routed through the shared descriptor —
+        # the same PlanTopology the compiler and derived census read
+        if len(self.plan_topology().axes) < 2:
             raise ValueError(
                 "hierarchical communicator needs a 2-axis (inter, intra) mesh; "
                 "use 'naive'/'flat'/'xla' for flat worlds")
 
-    def _allreduce_grad_traced(self, grads):
+    def _legacy_allreduce_grad_traced(self, grads):
+        # pre-planner lowering, kept as the census-parity reference
         inter_axes = self._data_axes[:-1]
         intra_axis = self._data_axes[-1]
         n = self.size

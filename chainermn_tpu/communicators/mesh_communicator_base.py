@@ -59,16 +59,25 @@ class _SplitControlPlane(cp_mod.ControlPlane):
 class MeshCommunicator(CommunicatorBase):
     """Communicator bound to (mesh, data_axes, control plane).
 
-    Subclasses override :meth:`_allreduce_grad_traced` with their collective
-    decomposition — that decomposition is the only thing that distinguishes
-    the reference's communicator zoo (naive/flat/hierarchical/...), and the
-    same is true here.
+    The collective decomposition is the only thing that distinguishes
+    the reference's communicator zoo (naive/flat/hierarchical/...), and
+    the same is true here — but the decomposition is now *data*: each
+    flavor names a fixed :class:`~chainermn_tpu.planner.ir.Plan` (via
+    the ``flavor`` class attribute), and :meth:`_allreduce_grad_traced`
+    feeds it to the one plan compiler
+    (:func:`chainermn_tpu.planner.compiler.execute_plan`).  Subclasses
+    keep their historical hand-lowered bodies as
+    ``_legacy_allreduce_grad_traced`` — the parity reference
+    ``tests/test_planner.py`` pins HLO-census equivalence against.
     """
 
     # Only the xla (pure_nccl analogue) communicator accepts a communication
     # dtype, mirroring create_communicator's restriction in the reference
     # factory 〔communicators/__init__.py〕.
     supports_allreduce_grad_dtype = False
+
+    #: fixed-plan name this class executes (chainermn_tpu.planner.plans)
+    flavor = "naive"
 
     def __init__(
         self,
@@ -176,8 +185,7 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def intra_size(self) -> int:
-        ax = self._data_axes[-1]
-        return int(self._mesh.shape[ax])
+        return self.plan_topology().intra_size
 
     @property
     def inter_rank(self) -> int:
@@ -188,7 +196,28 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def inter_size(self) -> int:
-        return self.size // self.intra_size
+        return self.plan_topology().inter_size
+
+    def plan_topology(self):
+        """This communicator's data axes as a serializable
+        :class:`~chainermn_tpu.planner.ir.PlanTopology` — the ONE source
+        of truth for group sizes: the plan compiler, the derived census
+        (``analysis.rules.expected_kinds``), the plan table key, and the
+        ``intra_size``/``inter_size`` properties all read it.  Last data
+        axis = the intra/ICI axis, by the mesh convention."""
+        from chainermn_tpu.planner.ir import PlanTopology
+        return PlanTopology(axes=tuple(
+            (a, int(self._mesh.shape[a])) for a in self._data_axes))
+
+    def plan(self):
+        """The fixed plan this flavor executes (xla threads its
+        communication dtype in as the plan's wire dtype)."""
+        from chainermn_tpu.planner.plans import flavor_plan
+        wire = None
+        if self.supports_allreduce_grad_dtype and \
+                self.allreduce_grad_dtype is not None:
+            wire = np.dtype(self.allreduce_grad_dtype).name
+        return flavor_plan(self.flavor, wire_dtype=wire)
 
     def intra_axis_index(self):
         """Device-level intra-node rank (position on the last data axis —
@@ -525,8 +554,15 @@ CompressionState` from :meth:`init_compression_state`) and the call
         return _packing.unpack([out], meta, scale=scale), state
 
     def _allreduce_grad_traced(self, grads):
-        """Default decomposition (naive): per-leaf psum over all data axes.
-        Subclasses override — that *is* the communicator zoo."""
+        """Execute this flavor's fixed plan through the one compiler.
+        The zoo's per-class hand-lowered bodies live on as
+        ``_legacy_allreduce_grad_traced`` parity references."""
+        from chainermn_tpu.planner.compiler import execute_plan
+        return execute_plan(self.plan(), self, grads)
+
+    def _legacy_allreduce_grad_traced(self, grads):
+        """Pre-planner decomposition (naive): per-leaf psum over all
+        data axes.  Kept verbatim as the census-parity reference."""
         n = self.size
         ax = self._axis_arg()
         return jax.tree.map(lambda g: lax.psum(g, ax) / n, grads)

@@ -14,4 +14,4 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 
 class NaiveCommunicator(MeshCommunicator):
-    pass  # the base's per-leaf psum *is* the naive decomposition
+    flavor = "naive"  # the base's per-leaf plan *is* the naive decomposition

@@ -22,6 +22,10 @@ from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
 
 
 class NonCudaAwareCommunicator(FlatCommunicator):
+    # same stage sequence as flat (host staging is eager-only), but its
+    # own plan name so sweep rows / plan tables attribute timings right
+    flavor = "non_cuda_aware"
+
     def allreduce_grad(self, grads, *, compressor=None, state=None):
         from chainermn_tpu.compression import base as _cbase
         from chainermn_tpu.compression import quantize as _cq

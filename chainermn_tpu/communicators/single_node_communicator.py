@@ -12,14 +12,19 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 
 class SingleNodeCommunicator(MeshCommunicator):
+    flavor = "single_node"
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if self.inter_size != 1:
+        # inter_size reads the shared PlanTopology descriptor — the same
+        # group sizes the plan compiler and derived census see
+        if self.plan_topology().inter_size != 1:
             raise ValueError(
                 f"single_node communicator requires inter_size == 1, got "
                 f"{self.inter_size}; use 'hierarchical' for multi-host worlds")
 
-    def _allreduce_grad_traced(self, grads):
+    def _legacy_allreduce_grad_traced(self, grads):
+        # pre-planner lowering, kept as the census-parity reference
         import jax
         intra_axis = self._data_axes[-1]
         inter_axes = self._data_axes[:-1]

@@ -29,16 +29,21 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 
 class TwoDimensionalCommunicator(MeshCommunicator):
+    flavor = "two_dimensional"
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if len(self._data_axes) < 2:
+        # group-size inference routed through the shared descriptor —
+        # the same PlanTopology the compiler and derived census read
+        if len(self.plan_topology().axes) < 2:
             raise ValueError(
                 "two_dimensional communicator needs a 2-axis (inter, intra) mesh")
 
-    def _allreduce_grad_traced(self, grads):
+    def _legacy_allreduce_grad_traced(self, grads):
+        # pre-planner lowering, kept as the census-parity reference
         inter_axes = self._data_axes[:-1]
         intra_axis = self._data_axes[-1]
-        intra_size = int(self._mesh.shape[intra_axis])
+        intra_size = self.plan_topology().intra_size
         me = lax.axis_index(intra_axis)
         buffers, meta = _packing.pack(grads)
         out = []

@@ -29,6 +29,7 @@ from chainermn_tpu.communicators.mesh_communicator_base import MeshCommunicator
 
 class XlaCommunicator(MeshCommunicator):
     supports_allreduce_grad_dtype = True
+    flavor = "xla"
 
     def __init__(self, *args, allreduce_grad_dtype=None, use_pallas_cast: bool = False,
                  **kwargs):
@@ -36,24 +37,40 @@ class XlaCommunicator(MeshCommunicator):
         self.use_pallas_cast = use_pallas_cast
 
     def _allreduce_grad_traced(self, grads):
+        if self.use_pallas_cast and self.allreduce_grad_dtype is not None:
+            # The Pallas cast+scale kernel path stays hand-lowered: it
+            # is a kernel-selection knob, not a decomposition (the stage
+            # sequence is identical to the plan's single all-reduce).
+            return self._pallas_allreduce_grad_traced(grads)
+        # Plan path: flat pack in the wire dtype, one all-reduce, fused
+        # cast-back+scale — the base delegates to the plan compiler.
+        return super()._allreduce_grad_traced(grads)
+
+    def _pallas_allreduce_grad_traced(self, grads):
         comm_dtype = self.allreduce_grad_dtype
         ax = self._axis_arg()
         scale = 1.0 / self.size
-        if self.use_pallas_cast and comm_dtype is not None:
-            from chainermn_tpu.ops.cast_scale import cast_scale
+        from chainermn_tpu.ops.cast_scale import cast_scale
 
-            # Per-dtype groups keep each leaf's original dtype in meta so the
-            # cast-back target is known per buffer.
-            buffers, meta = _packing.pack(grads)
-            _, group_dtypes, _ = meta
-            comm_bufs = [cast_scale(b, comm_dtype, 1.0) for b in buffers]
-            comm_bufs = [lax.psum(b, ax) for b in comm_bufs]
-            out = [cast_scale(b, jnp.dtype(k), scale)
-                   for b, k in zip(comm_bufs, group_dtypes)]
-            return _packing.unpack(out, meta, scale=None)
+        # Per-dtype groups keep each leaf's original dtype in meta so the
+        # cast-back target is known per buffer.
+        buffers, meta = _packing.pack(grads)
+        _, group_dtypes, _ = meta
+        comm_bufs = [cast_scale(b, comm_dtype, 1.0) for b in buffers]
+        comm_bufs = [lax.psum(b, ax) for b in comm_bufs]
+        out = [cast_scale(b, jnp.dtype(k), scale)
+               for b, k in zip(comm_bufs, group_dtypes)]
+        return _packing.unpack(out, meta, scale=None)
+
+    def _legacy_allreduce_grad_traced(self, grads):
+        # pre-planner lowering, kept as the census-parity reference
+        if self.use_pallas_cast and self.allreduce_grad_dtype is not None:
+            return self._pallas_allreduce_grad_traced(grads)
+        comm_dtype = self.allreduce_grad_dtype
+        ax = self._axis_arg()
         buffers, meta = _packing.pack(grads, comm_dtype=comm_dtype)
         buffers = [lax.psum(b, ax) for b in buffers]
-        return _packing.unpack(buffers, meta, scale=scale)
+        return _packing.unpack(buffers, meta, scale=1.0 / self.size)
 
 
 # The reference name, kept as an alias so stock scripts'

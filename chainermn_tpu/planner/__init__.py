@@ -1,0 +1,66 @@
+"""Hierarchical collective planner (ROADMAP item 1, HiCCL direction).
+
+The decomposition IS the communicator spec: a :class:`Plan` is a
+serializable sequence of collective :class:`Stage` records over a
+declared :class:`PlanTopology`; :func:`execute_plan` is the ONE compiler
+lowering any plan to traced primitives; :func:`flavor_plan` gives the
+seven legacy flavors as fixed plans; :class:`PlanTable` +
+:func:`autotune_from_rows` select per-message-size plans from
+``bench_allreduce --sweep`` data for ``create_communicator("auto")``.
+
+See docs/collective_planner.md.
+"""
+
+from chainermn_tpu.planner.autotune import (
+    BUCKET_EDGES,
+    FIXED_PLAN_NAMES,
+    PLAN_TABLE_SCHEMA,
+    PlanTable,
+    SWEEP_SCHEMA,
+    autotune_from_rows,
+    size_bucket,
+    validate_sweep_rows,
+)
+from chainermn_tpu.planner.compiler import (
+    execute_plan,
+    plan_census_kinds,
+    plan_wire_bytes,
+)
+from chainermn_tpu.planner.ir import (
+    Plan,
+    PlanError,
+    PlanTopology,
+    SCOPES,
+    STAGE_OPS,
+    Stage,
+    load_plan,
+)
+from chainermn_tpu.planner.plans import (
+    FLAVOR_NAMES,
+    candidate_plans,
+    flavor_plan,
+)
+
+__all__ = [
+    "BUCKET_EDGES",
+    "FIXED_PLAN_NAMES",
+    "FLAVOR_NAMES",
+    "PLAN_TABLE_SCHEMA",
+    "Plan",
+    "PlanError",
+    "PlanTable",
+    "PlanTopology",
+    "SCOPES",
+    "STAGE_OPS",
+    "SWEEP_SCHEMA",
+    "Stage",
+    "autotune_from_rows",
+    "candidate_plans",
+    "execute_plan",
+    "flavor_plan",
+    "load_plan",
+    "plan_census_kinds",
+    "plan_wire_bytes",
+    "size_bucket",
+    "validate_sweep_rows",
+]

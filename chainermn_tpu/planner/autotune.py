@@ -1,0 +1,193 @@
+"""Plan autotuner — per-(topology, dtype, message-size-bucket) plan
+selection from ``bench_allreduce`` sweep rows, cached as an on-disk
+plan table.
+
+Workflow (docs/collective_planner.md):
+
+1. ``python benchmarks/bench_allreduce.py --sweep sweep.json`` times
+   every candidate plan (``planner.plans.candidate_plans``) across a
+   message-size ladder and emits schema rows
+   ``{"topology", "dtype", "bytes", "plan", "us", "plan_spec"}``
+   under ``{"schema": "allreduce_sweep/v1"}``.
+2. :func:`autotune_from_rows` picks the fastest plan per (topology,
+   dtype, size bucket) cell and returns the :class:`PlanTable` plus the
+   tuned-vs-best-fixed comparison rows ``tools/perf_gate.py --planner``
+   gates on.
+3. ``PlanTable.save`` writes the table;
+   ``create_communicator("auto", plan_table=...)`` loads it and routes
+   each ``allreduce_grad`` through the plan for its packed byte size.
+
+The table is keyed by bucket, not exact size, so one tuning run
+generalizes: message sizes within a bucket share bandwidth regime
+(power-of-16 edges, the same ladder the sweep samples).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from chainermn_tpu.planner.ir import Plan, PlanTopology
+from chainermn_tpu.planner.plans import flavor_plan
+
+SWEEP_SCHEMA = "allreduce_sweep/v1"
+PLAN_TABLE_SCHEMA = "plan_table/v1"
+
+#: size-bucket upper edges in bytes (power-of-16 ladder; last is open)
+BUCKET_EDGES = (4 << 10, 64 << 10, 1 << 20, 16 << 20, 256 << 20)
+
+
+def size_bucket(nbytes: int) -> str:
+    """Bucket label for a payload size, e.g. ``"<=64KiB"`` / ``">256MiB"``."""
+    for edge in BUCKET_EDGES:
+        if nbytes <= edge:
+            if edge >= 1 << 20:
+                return f"<={edge >> 20}MiB"
+            return f"<={edge >> 10}KiB"
+    return f">{BUCKET_EDGES[-1] >> 20}MiB"
+
+
+@dataclass
+class PlanTable:
+    """On-disk map (topology key, dtype, size bucket) -> :class:`Plan`.
+
+    ``entries`` keys are the 3-tuples; :meth:`lookup` resolves a live
+    (topology, dtype, nbytes) query with fallback order exact-cell ->
+    any-bucket-same-topology-and-dtype (nearest bucket) -> miss (None;
+    the auto communicator then uses its default plan).
+    """
+
+    entries: Dict[Tuple[str, str, str], Plan] = field(default_factory=dict)
+    #: provenance rows from the tuning run (kept in the artifact so a
+    #: reviewer can see what each cell won against)
+    meta: dict = field(default_factory=dict)
+
+    def put(self, topology: PlanTopology, dtype: str, bucket: str,
+            plan: Plan) -> None:
+        self.entries[(topology.key(), str(dtype), bucket)] = plan
+
+    def lookup(self, topology: PlanTopology, dtype: str,
+               nbytes: int) -> Optional[Plan]:
+        tkey = topology.key()
+        dtype = str(dtype)
+        exact = self.entries.get((tkey, dtype, size_bucket(nbytes)))
+        if exact is not None:
+            return exact
+        # nearest bucket for the same (topology, dtype): tuning runs may
+        # not have swept every rung of the ladder
+        ladder = [size_bucket(e) for e in BUCKET_EDGES] + [
+            size_bucket(BUCKET_EDGES[-1] + 1)]
+        want = ladder.index(size_bucket(nbytes))
+        best = None
+        best_dist = None
+        for (t, d, b), plan in self.entries.items():
+            if t != tkey or d != dtype or b not in ladder:
+                continue
+            dist = abs(ladder.index(b) - want)
+            if best_dist is None or dist < best_dist:
+                best, best_dist = plan, dist
+        return best
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": PLAN_TABLE_SCHEMA,
+            "meta": self.meta,
+            "entries": [
+                {"topology": t, "dtype": d, "bucket": b,
+                 "plan": plan.to_dict()}
+                for (t, d, b), plan in sorted(self.entries.items())],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanTable":
+        schema = d.get("schema", PLAN_TABLE_SCHEMA)
+        if schema != PLAN_TABLE_SCHEMA:
+            raise ValueError(
+                f"unsupported plan-table schema {schema!r} "
+                f"(this build reads {PLAN_TABLE_SCHEMA!r})")
+        table = cls(meta=dict(d.get("meta", {})))
+        for e in d.get("entries", []):
+            table.entries[(e["topology"], e["dtype"], e["bucket"])] = \
+                Plan.from_dict(e["plan"])
+        return table
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "PlanTable":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def validate_sweep_rows(rows: List[dict]) -> None:
+    for i, r in enumerate(rows):
+        for k in ("topology", "dtype", "bytes", "plan", "us"):
+            if k not in r:
+                raise ValueError(
+                    f"sweep row {i} missing {k!r} (schema "
+                    f"{SWEEP_SCHEMA}): {r}")
+
+
+#: plan names that are fixed communicator flavors (the baseline the
+#: tuned table must beat); everything else in a sweep is a candidate
+#: only the planner can express
+FIXED_PLAN_NAMES = ("naive", "flat", "hierarchical", "two_dimensional",
+                    "single_node", "non_cuda_aware", "xla")
+
+
+def autotune_from_rows(rows: List[dict]):
+    """Select the fastest plan per (topology, dtype, bucket) cell.
+
+    Returns ``(table, comparison)`` where ``comparison`` has one row per
+    cell::
+
+        {"topology", "dtype", "bucket", "tuned_plan", "tuned_us",
+         "best_fixed_plan", "best_fixed_us", "speedup"}
+
+    ``speedup > 1`` means the tuned pick beats the best fixed flavor in
+    that cell — the acceptance criterion ``tools/perf_gate.py
+    --planner`` gates on (it requires at least one strictly-better
+    cell).  Within a cell a plan's time is the MEAN over the sweep's
+    sizes in that bucket, so a plan must win across the bucket, not on
+    one lucky rung.
+    """
+    validate_sweep_rows(rows)
+    # cell -> plan name -> [(us, plan_spec)]
+    cells: Dict[tuple, Dict[str, List[tuple]]] = {}
+    for r in rows:
+        cell = (r["topology"], str(r["dtype"]), size_bucket(int(r["bytes"])))
+        cells.setdefault(cell, {}).setdefault(r["plan"], []).append(
+            (float(r["us"]), r.get("plan_spec")))
+    table = PlanTable(meta={"schema_in": SWEEP_SCHEMA, "rows": len(rows)})
+    comparison: List[dict] = []
+    for cell, by_plan in sorted(cells.items()):
+        tkey, dtype, bucket = cell
+        means = {name: sum(u for u, _ in samples) / len(samples)
+                 for name, samples in by_plan.items()}
+        tuned_name = min(means, key=lambda n: means[n])
+        fixed = {n: u for n, u in means.items() if n in FIXED_PLAN_NAMES}
+        best_fixed = min(fixed, key=lambda n: fixed[n]) if fixed else None
+        spec = next((s for _, s in by_plan[tuned_name] if s is not None),
+                    None)
+        plan = (Plan.from_dict(spec) if spec is not None
+                else flavor_plan(tuned_name))
+        topology = PlanTopology.from_key(tkey)
+        table.put(topology, dtype, bucket, plan)
+        comparison.append({
+            "topology": tkey, "dtype": dtype, "bucket": bucket,
+            "tuned_plan": tuned_name, "tuned_us": means[tuned_name],
+            "best_fixed_plan": best_fixed,
+            "best_fixed_us": fixed.get(best_fixed) if best_fixed else None,
+            "speedup": (fixed[best_fixed] / means[tuned_name])
+            if best_fixed else None,
+        })
+    return table, comparison
+
+
+__all__ = ["BUCKET_EDGES", "FIXED_PLAN_NAMES", "PLAN_TABLE_SCHEMA",
+           "PlanTable", "SWEEP_SCHEMA", "autotune_from_rows",
+           "size_bucket", "validate_sweep_rows"]

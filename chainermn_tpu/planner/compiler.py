@@ -1,0 +1,278 @@
+"""Plan compiler — lowers a :class:`~chainermn_tpu.planner.ir.Plan` to
+today's traced primitives.
+
+ONE lowering serves every plan; the seven communicator flavors are fixed
+plans fed through here (``tests/test_planner.py`` pins HLO-census parity
+against the legacy per-class decompositions via the shared
+``analysis/hlo.py`` parser).  The conventions the compiler must respect,
+inherited from the code it replaces:
+
+* **packing** — flat plans run over ``_packing.pack`` buffers with the
+  1/size mean fused into ``unpack`` (scale applied AFTER the cast back,
+  see ``_packing.unpack``); leaf plans apply the mean per leaf after the
+  stage chain, exactly like the naive/hierarchical bodies did.
+* **padding** — a reduce-scatter pads its buffer to a multiple of the
+  scope size with ``_packing.pad_to_multiple`` and the matching
+  all-gather strips it, the two_dimensional/FSDP layout convention.
+* **masked-psum all-gather** — the default gather-back is the
+  dynamic_update_slice + psum form, NOT ``lax.all_gather``: psum output
+  is invariant-typed, a native all_gather's varying-axes type would
+  poison replicated out_specs downstream (two_dimensional's module
+  docstring has the full story).  ``lowering: "native"`` opts into the
+  cheaper true gather when the caller owns the out_spec consequences.
+* **degenerate scopes** — a stage whose scope resolves to NO axes is
+  skipped (the legacy ``if inter_axes:`` guard); a stage over axes of
+  size 1 IS emitted — XLA does not elide singleton-group collectives,
+  and the type-clearing psum over a trivial inter axis is load-bearing
+  (see single_node).
+* **transpose pinning** — the compiler emits raw collectives, same as
+  the legacy ``_allreduce_grad_traced`` bodies; differentiating THROUGH
+  an executed plan goes via ``chainermn_tpu.functions.allreduce``'s
+  custom VJP, unchanged.
+
+:func:`plan_census_kinds` is the static mirror of the lowering: the
+expected HLO collective-kind sequence of a compiled plan, read off the
+IR.  ``analysis/rules.expected_kinds`` is now a thin wrapper over it —
+the census table is derived, not maintained.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from chainermn_tpu.planner.ir import Plan, PlanError, PlanTopology, Stage
+
+
+def _axis_arg(axes: Tuple[str, ...]):
+    """Single axis name when there is one, tuple otherwise — the same
+    normalization ``MeshCommunicator._axis_arg`` applies."""
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _with_wire(buf, wire_dtype: Optional[str], fn):
+    """Run ``fn`` with ``buf`` cast to the stage wire dtype (if any),
+    casting the result back to the original dtype — the per-stage cast
+    seam per-hop compression (DynamiQ, ROADMAP item 2) extends."""
+    if wire_dtype is None:
+        return fn(buf)
+    orig = buf.dtype
+    wire = jnp.dtype(wire_dtype)
+    if wire == orig:
+        return fn(buf)
+    return fn(buf.astype(wire)).astype(orig)
+
+
+class _ShardFrame:
+    """Book-keeping for one live reduce-scatter (popped by the matching
+    all-gather)."""
+
+    def __init__(self, scope: str, axis: str, size: int, padded_len: int,
+                 strip):
+        self.scope = scope
+        self.axis = axis
+        self.size = size
+        self.padded_len = padded_len
+        self.strip = strip
+
+
+def _run_stages_flat(plan: Plan, topology: PlanTopology, buf):
+    """Apply the stage chain to one flat buffer."""
+    from chainermn_tpu.communicators import _packing
+
+    shard_stack: List[_ShardFrame] = []
+    for st in plan.stages:
+        axes = topology.scope_axes(st.scope)
+        if not axes:
+            continue
+        if st.op == "all-reduce":
+            buf = _with_wire(buf, st.wire_dtype,
+                             lambda b: lax.psum(b, _axis_arg(axes)))
+        elif st.op == "reduce-scatter":
+            if len(axes) != 1:
+                raise PlanError(
+                    f"reduce-scatter scope {st.scope!r} resolves to "
+                    f"{axes} — psum_scatter shards over exactly one axis; "
+                    "declare a topology whose scope is a single axis")
+            size = topology.scope_size(st.scope)
+            buf, strip = _packing.pad_to_multiple(buf, size)
+            frame = _ShardFrame(st.scope, axes[0], size,
+                                int(buf.shape[0]), strip)
+            buf = _with_wire(
+                buf, st.wire_dtype,
+                lambda b: lax.psum_scatter(b, axes[0], tiled=True))
+            shard_stack.append(frame)
+        elif st.op == "all-gather":
+            frame = shard_stack.pop()  # validate() guarantees matching
+            if st.lowering == "native":
+                buf = _with_wire(
+                    buf, st.wire_dtype,
+                    lambda b: lax.all_gather(b, frame.axis, tiled=True))
+            else:
+                me = lax.axis_index(frame.axis)
+                shard_len = frame.padded_len // frame.size
+
+                def gather(b):
+                    placed = lax.dynamic_update_slice_in_dim(
+                        jnp.zeros((frame.padded_len,), b.dtype), b,
+                        me * shard_len, 0)
+                    return lax.psum(placed, frame.axis)
+
+                buf = _with_wire(buf, st.wire_dtype, gather)
+            buf = frame.strip(buf)
+        elif st.op == "multicast":
+            idx = lax.axis_index(_axis_arg(axes))
+
+            def bcast(b):
+                masked = jnp.where(idx == st.root, b, jnp.zeros_like(b))
+                return lax.psum(masked, _axis_arg(axes))
+
+            buf = _with_wire(buf, st.wire_dtype, bcast)
+        elif st.op == "p2p":
+            if len(axes) != 1:
+                raise PlanError(
+                    f"p2p scope {st.scope!r} resolves to {axes} — "
+                    "ppermute rings run over exactly one axis")
+            n = topology.scope_size(st.scope)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            buf = _with_wire(buf, st.wire_dtype,
+                             lambda b: lax.ppermute(b, axes[0], perm))
+        else:  # pragma: no cover — ir validation rejects unknown ops
+            raise PlanError(f"unknown stage op {st.op!r}")
+    return buf
+
+
+def _run_stages_leaf(plan: Plan, topology: PlanTopology, leaf):
+    """Leaf-mode chain: all-reduce/multicast/p2p only (ir.validate)."""
+    for st in plan.stages:
+        axes = topology.scope_axes(st.scope)
+        if not axes:
+            continue
+        if st.op == "all-reduce":
+            leaf = _with_wire(leaf, st.wire_dtype,
+                              lambda v: lax.psum(v, _axis_arg(axes)))
+        elif st.op == "multicast":
+            idx = lax.axis_index(_axis_arg(axes))
+
+            def bcast(v):
+                masked = jnp.where(idx == st.root, v, jnp.zeros_like(v))
+                return lax.psum(masked, _axis_arg(axes))
+
+            leaf = _with_wire(leaf, st.wire_dtype, bcast)
+        elif st.op == "p2p":
+            n = topology.scope_size(st.scope)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            leaf = _with_wire(leaf, st.wire_dtype,
+                              lambda v: lax.ppermute(v, axes[0], perm))
+        else:  # pragma: no cover — leaf validation rejects sharding ops
+            raise PlanError(
+                f"stage op {st.op!r} is not legal under leaf packing")
+    return leaf
+
+
+def execute_plan(plan: Plan, comm, grads):
+    """Run ``plan`` as ``comm``'s gradient mean — the one lowering every
+    flavor's ``_allreduce_grad_traced`` now delegates to.
+
+    ``comm`` supplies the axis names and world size through
+    ``comm.plan_topology()`` (the shared Topology-derived descriptor —
+    one source of truth for group sizes).  Must be called inside an SPMD
+    region, like the methods it replaces.
+    """
+    from chainermn_tpu.communicators import _packing
+
+    topology = comm.plan_topology()
+    n = topology.size
+    if plan.packing == "leaf":
+        return jax.tree.map(
+            lambda g: _run_stages_leaf(plan, topology, g) / n, grads)
+    buffers, meta = _packing.pack(
+        grads,
+        comm_dtype=jnp.dtype(plan.wire_dtype)
+        if plan.wire_dtype is not None else None)
+    buffers = [_run_stages_flat(plan, topology, b) for b in buffers]
+    return _packing.unpack(buffers, meta, scale=1.0 / n)
+
+
+#: stage op -> HLO collective kind its default lowering compiles to
+_CENSUS_KIND = {
+    "all-reduce": "all-reduce",
+    "reduce-scatter": "reduce-scatter",
+    # default all-gather lowering is the masked psum (invariant-typed)
+    "all-gather": "all-reduce",
+    "multicast": "all-reduce",
+    "p2p": "collective-permute",
+}
+
+
+def plan_census_kinds(plan: Plan, topology: PlanTopology) -> tuple:
+    """Expected HLO collective-kind sequence of ``plan`` compiled against
+    ``topology`` — the census, derived from the IR.
+
+    Per packed buffer (flat) / per leaf (leaf): the census probes in
+    ``analysis/lint.allreduce_hlo`` and ``tests/test_census.py`` trace a
+    single-leaf single-dtype tree, so the sequence is the whole program.
+    A stage over a scope with NO axes emits nothing (it is skipped by
+    the compiler); a stage over axes of size 1 IS counted — XLA keeps
+    singleton-group collectives (measured on the CPU mesh; the old
+    hand-written table got exactly this wrong at ``inter == 1``).
+    """
+    kinds = []
+    for st in plan.stages:
+        if not topology.scope_axes(st.scope):
+            continue
+        if st.op == "all-gather" and st.lowering == "native":
+            kinds.append("all-gather")
+        else:
+            kinds.append(_CENSUS_KIND[st.op])
+    return tuple(kinds)
+
+
+def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
+                    dtype="float32") -> dict:
+    """Static per-scope wire-cost model of a plan moving ``nbytes`` of
+    ``dtype`` payload: bytes each scope's links carry per device, using
+    ring costs (all-reduce 2x, reduce-scatter/all-gather 1x, p2p
+    1/size).  Used by the autotuner to break timing ties and by the docs
+    to explain WHY a plan wins a cell; not a substitute for measurement.
+    """
+    item = np.dtype(dtype).itemsize
+    costs: dict = {}
+    frac = 1.0  # fraction of the payload live at the current stage
+    for st in plan.stages:
+        axes = topology.scope_axes(st.scope)
+        if not axes:
+            continue
+        size = topology.scope_size(st.scope)
+        wire_item = (np.dtype(st.wire_dtype).itemsize
+                     if st.wire_dtype else
+                     np.dtype(plan.wire_dtype).itemsize
+                     if plan.wire_dtype else item)
+        stage_bytes = nbytes * frac * (wire_item / item)
+        if st.op == "all-reduce":
+            moved = 2.0 * stage_bytes * (size - 1) / max(size, 1)
+        elif st.op == "reduce-scatter":
+            moved = stage_bytes * (size - 1) / max(size, 1)
+            frac /= size
+        elif st.op == "all-gather":
+            gathered = stage_bytes * size
+            if st.lowering == "native":
+                moved = gathered * (size - 1) / max(size, 1)
+            else:  # masked psum pays ring-allreduce cost on full length
+                moved = 2.0 * gathered * (size - 1) / max(size, 1)
+            frac *= size
+        elif st.op == "multicast":
+            moved = 2.0 * stage_bytes * (size - 1) / max(size, 1)
+        elif st.op == "p2p":
+            moved = stage_bytes
+        else:  # pragma: no cover
+            moved = stage_bytes
+        costs[st.scope] = costs.get(st.scope, 0.0) + moved
+    return costs
+
+
+__all__ = ["execute_plan", "plan_census_kinds", "plan_wire_bytes"]

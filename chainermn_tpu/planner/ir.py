@@ -1,0 +1,299 @@
+"""Stage IR for collective plans — the decomposition AS data.
+
+HiCCL's thesis (PAPERS.md) inverted: instead of seven communicator
+classes each hard-coding its collective decomposition, a decomposition
+is a :class:`Plan` — an ordered tuple of :class:`Stage` records over a
+declared :class:`PlanTopology` — and ONE compiler
+(:mod:`chainermn_tpu.planner.compiler`) lowers any plan to today's
+traced primitives.  The seven flavors become fixed plans
+(:mod:`chainermn_tpu.planner.plans`); the autotuner
+(:mod:`chainermn_tpu.planner.autotune`) selects per-message-size plans
+from ``bench_allreduce`` sweep rows.
+
+Everything here is serializable: plans round-trip through
+``to_dict``/``from_dict`` (and JSON) so a plan table can live on disk,
+ride a checkpoint sidecar, or be diffed in review — the plan IS the
+communicator spec, so it must be an artifact, not a closure.
+
+Stage vocabulary (the HiCCL/multicast stage set the ROADMAP names):
+
+``all-reduce``
+    psum over the scope's axes; works on full buffers and on shards.
+``reduce-scatter``
+    psum_scatter over ONE scope axis; the buffer becomes a shard
+    (padded to a multiple of the scope size first — the ``_packing``
+    pad convention).
+``all-gather``
+    inverse of the innermost live reduce-scatter.  Default lowering is
+    the masked-psum gather-back (invariant-typed output — see the
+    two_dimensional communicator's module docstring for why a native
+    ``all_gather`` would poison replicated out_specs); ``lowering:
+    "native"`` requests ``lax.all_gather``.
+``multicast``
+    broadcast from ``root`` over the scope (masked psum lowering).
+``p2p``
+    one ring hop (``ppermute`` by +1) over the scope axis — the stage
+    vocabulary seam per-hop pipelines (DynamiQ, ROADMAP item 2) build
+    on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+#: stage op kinds (the plan vocabulary)
+STAGE_OPS = ("all-reduce", "reduce-scatter", "all-gather", "multicast",
+             "p2p")
+
+#: symbolic axis scopes a stage communicates over.  "intra" is the last
+#: (ICI) data axis, "inter" the leading (DCN-ish) axes, "all" every data
+#: axis — resolved against a PlanTopology at compile time.
+SCOPES = ("intra", "inter", "all")
+
+
+class PlanError(ValueError):
+    """A structurally invalid plan (unknown op/scope, unbalanced
+    reduce-scatter/all-gather nesting, plan ends sharded, ...)."""
+
+
+@dataclass(frozen=True)
+class PlanTopology:
+    """Serializable ICI×DCN topology descriptor a plan compiles against.
+
+    ``axes`` is the ordered ``(name, size)`` tuple of the communicator's
+    data axes, LAST axis = the intra/ICI axis (the mesh convention every
+    communicator already uses).  Mesh communicators export theirs via
+    ``comm.plan_topology()`` — the one source of truth for group sizes
+    that ``expected_kinds``, the compiler, and the plan table all share.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    def __post_init__(self):
+        if not self.axes:
+            raise PlanError("topology needs at least one axis")
+        norm = tuple((str(n), int(s)) for n, s in self.axes)
+        object.__setattr__(self, "axes", norm)
+        for name, size in norm:
+            if size < 1:
+                raise PlanError(f"axis {name!r} has size {size} < 1")
+
+    @property
+    def size(self) -> int:
+        out = 1
+        for _, s in self.axes:
+            out *= s
+        return out
+
+    @property
+    def intra_size(self) -> int:
+        return self.axes[-1][1]
+
+    @property
+    def inter_size(self) -> int:
+        return self.size // self.intra_size
+
+    def scope_axes(self, scope: str) -> Tuple[str, ...]:
+        """Axis names a symbolic scope resolves to (may be empty — e.g.
+        "inter" on a single-axis sub-world; the compiler skips such
+        stages, matching the legacy ``if inter_axes:`` guards)."""
+        if scope == "all":
+            return tuple(n for n, _ in self.axes)
+        if scope == "intra":
+            return (self.axes[-1][0],)
+        if scope == "inter":
+            return tuple(n for n, _ in self.axes[:-1])
+        raise PlanError(f"unknown scope {scope!r}; one of {SCOPES}")
+
+    def scope_size(self, scope: str) -> int:
+        sizes = dict(self.axes)
+        out = 1
+        for name in self.scope_axes(scope):
+            out *= sizes[name]
+        return out
+
+    def key(self) -> str:
+        """Canonical string key for plan tables / sweep rows, e.g.
+        ``"inter:2,intra:4"``."""
+        return ",".join(f"{n}:{s}" for n, s in self.axes)
+
+    def to_dict(self) -> dict:
+        return {"axes": [[n, s] for n, s in self.axes]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanTopology":
+        return cls(axes=tuple((n, s) for n, s in d["axes"]))
+
+    @classmethod
+    def from_key(cls, key: str) -> "PlanTopology":
+        axes = []
+        for part in key.split(","):
+            name, _, size = part.partition(":")
+            axes.append((name, int(size)))
+        return cls(axes=tuple(axes))
+
+
+@dataclass(frozen=True)
+class Stage:
+    """One collective stage of a plan."""
+
+    op: str
+    scope: str = "all"
+    #: numpy dtype name the wire carries for THIS stage (cast in before,
+    #: cast back after — the per-hop seam); None inherits the buffer's
+    #: dtype.
+    wire_dtype: Optional[str] = None
+    #: alternative lowering; "" = the stage's default
+    lowering: str = ""
+    #: multicast root rank on the scope axes
+    root: int = 0
+
+    def __post_init__(self):
+        if self.op not in STAGE_OPS:
+            raise PlanError(
+                f"unknown stage op {self.op!r}; one of {STAGE_OPS}")
+        if self.scope not in SCOPES:
+            raise PlanError(
+                f"unknown scope {self.scope!r}; one of {SCOPES}")
+        if self.lowering and self.op != "all-gather":
+            raise PlanError(
+                f"lowering={self.lowering!r} only applies to all-gather")
+        if self.lowering not in ("", "masked-psum", "native"):
+            raise PlanError(f"unknown lowering {self.lowering!r}")
+        if self.wire_dtype is not None:
+            import numpy as np
+            try:
+                np.dtype(self.wire_dtype)
+            except TypeError as e:
+                raise PlanError(
+                    f"bad wire_dtype {self.wire_dtype!r}: {e}") from None
+
+    def to_dict(self) -> dict:
+        d = {"op": self.op, "scope": self.scope}
+        if self.wire_dtype is not None:
+            d["wire_dtype"] = self.wire_dtype
+        if self.lowering:
+            d["lowering"] = self.lowering
+        if self.root:
+            d["root"] = self.root
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Stage":
+        return cls(op=d["op"], scope=d.get("scope", "all"),
+                   wire_dtype=d.get("wire_dtype"),
+                   lowering=d.get("lowering", ""),
+                   root=int(d.get("root", 0)))
+
+
+@dataclass(frozen=True)
+class Plan:
+    """An ordered collective decomposition — the communicator spec.
+
+    ``packing`` selects the buffer convention the stages run over:
+
+    * ``"flat"`` — gradients pack into flat per-dtype buffers
+      (``_packing.pack``), stages run per buffer, the 1/size mean fuses
+      into unpack.  The flat/xla/two_dimensional convention.
+    * ``"leaf"`` — stages run per gradient leaf (no packing), mean
+      applied per leaf.  The naive/hierarchical/single_node convention.
+      Only all-reduce/multicast/p2p stages are legal (a reduce-scatter
+      shard of an arbitrary-shaped leaf has no defined layout).
+
+    ``wire_dtype`` is the packed-buffer communication dtype (the legacy
+    ``allreduce_grad_dtype`` knob as plan data; flat packing only).
+    """
+
+    name: str
+    stages: Tuple[Stage, ...]
+    packing: str = "flat"
+    wire_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "stages", tuple(self.stages))
+        self.validate()
+
+    def validate(self) -> "Plan":
+        if self.packing not in ("flat", "leaf"):
+            raise PlanError(f"unknown packing {self.packing!r}")
+        if not self.stages:
+            raise PlanError(f"plan {self.name!r} has no stages")
+        if self.wire_dtype is not None and self.packing != "flat":
+            raise PlanError("wire_dtype requires flat packing")
+        shard_stack = []
+        for i, st in enumerate(self.stages):
+            if not isinstance(st, Stage):
+                raise PlanError(f"stage {i} is not a Stage: {st!r}")
+            if st.op == "reduce-scatter":
+                if self.packing != "flat":
+                    raise PlanError(
+                        f"plan {self.name!r}: reduce-scatter (stage {i}) "
+                        "requires flat packing")
+                shard_stack.append(st.scope)
+            elif st.op == "all-gather":
+                if not shard_stack:
+                    raise PlanError(
+                        f"plan {self.name!r}: all-gather (stage {i}) "
+                        "without a live reduce-scatter")
+                top = shard_stack.pop()
+                if top != st.scope:
+                    raise PlanError(
+                        f"plan {self.name!r}: all-gather (stage {i}) over "
+                        f"scope {st.scope!r} does not match the innermost "
+                        f"reduce-scatter scope {top!r}")
+        if shard_stack:
+            raise PlanError(
+                f"plan {self.name!r} ends sharded over {shard_stack} — "
+                "every reduce-scatter needs a matching all-gather (or "
+                "the consumer must be a sharded-state engine like FSDP, "
+                "which has its own scheduler)")
+        return self
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "packing": self.packing,
+             "stages": [s.to_dict() for s in self.stages]}
+        if self.wire_dtype is not None:
+            d["wire_dtype"] = self.wire_dtype
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        return cls(name=d["name"],
+                   stages=tuple(Stage.from_dict(s) for s in d["stages"]),
+                   packing=d.get("packing", "flat"),
+                   wire_dtype=d.get("wire_dtype"))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "Plan":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Plan":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    def with_name(self, name: str) -> "Plan":
+        return dataclasses.replace(self, name=name)
+
+
+def load_plan(path_or_dict) -> Plan:
+    """Coerce a plan file path / dict / Plan into a :class:`Plan`."""
+    if isinstance(path_or_dict, Plan):
+        return path_or_dict
+    if isinstance(path_or_dict, dict):
+        return Plan.from_dict(path_or_dict)
+    return Plan.load(path_or_dict)
+
+
+__all__ = ["Plan", "PlanError", "PlanTopology", "SCOPES", "STAGE_OPS",
+           "Stage", "load_plan"]

@@ -1,0 +1,106 @@
+"""Fixed plans — the seven communicator flavors as plan data.
+
+Each entry reproduces one legacy ``_allreduce_grad_traced`` decomposition
+exactly (the parity tests in ``tests/test_planner.py`` pin census-level
+equivalence through the shared ``analysis/hlo.py`` parser), so the flavor
+classes can all delegate to the one plan compiler.  ``candidate_plans``
+extends the fixed set with tuning knobs (wire dtype, decomposition ×
+message-size tradeoffs) for the autotuner to measure.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from chainermn_tpu.planner.ir import Plan, PlanTopology, Stage
+
+
+def _ar(scope: str, **kw) -> Stage:
+    return Stage(op="all-reduce", scope=scope, **kw)
+
+
+#: flavor name -> plan factory (wire_dtype threaded for the xla flavor)
+def flavor_plan(name: str, wire_dtype: Optional[str] = None) -> Plan:
+    """The fixed plan a named communicator flavor executes.
+
+    ``wire_dtype`` is a numpy dtype *name* (e.g. ``"bfloat16"``) — only
+    meaningful for flavors with flat packing; the xla flavor is the one
+    whose factory knob sets it (``allreduce_grad_dtype``).
+    """
+    if name in ("pure_nccl", "xla"):
+        return Plan(name="xla", packing="flat", wire_dtype=wire_dtype,
+                    stages=(_ar("all"),))
+    if wire_dtype is not None:
+        raise ValueError(f"flavor {name!r} takes no wire_dtype")
+    if name == "naive":
+        # per-leaf psum over all data axes (the base class default)
+        return Plan(name="naive", packing="leaf", stages=(_ar("all"),))
+    if name in ("flat", "non_cuda_aware"):
+        # non_cuda_aware's TRACED decomposition is flat (host staging is
+        # an eager-mode behavior — see its module docstring)
+        return Plan(name=name, packing="flat", stages=(_ar("all"),))
+    if name in ("hierarchical", "single_node"):
+        # per-leaf psum(intra) then psum(inter).  single_node runs the
+        # same stages on an inter_size==1 topology, where the inter psum
+        # exists to clear the device-varying type (it moves no data —
+        # the compiler keeps it whenever inter axes exist, matching the
+        # legacy ``if inter_axes:`` guard).
+        return Plan(name=name, packing="leaf",
+                    stages=(_ar("intra"), _ar("inter")))
+    if name == "two_dimensional":
+        # RS(intra) -> AR(inter) on the shard -> masked-psum gather-back
+        return Plan(name="two_dimensional", packing="flat", stages=(
+            Stage(op="reduce-scatter", scope="intra"),
+            _ar("inter"),
+            Stage(op="all-gather", scope="intra", lowering="masked-psum"),
+        ))
+    raise ValueError(f"unknown flavor {name!r}")
+
+
+#: the flavors with distinct plans (pure_nccl aliases xla; non_cuda_aware
+#: shares flat's stages but keeps its own plan name)
+FLAVOR_NAMES = ("naive", "flat", "hierarchical", "two_dimensional",
+                "single_node", "non_cuda_aware", "xla")
+
+
+def candidate_plans(topology: PlanTopology,
+                    wire_dtypes: tuple = ("bfloat16",)) -> List[Plan]:
+    """The autotuner's search space for one topology.
+
+    Always includes every fixed flavor legal on the topology (so the
+    tuned table is never worse than the best fixed flavor on the run it
+    was tuned from), plus reduced-precision-wire variants of the flat
+    decompositions — the knob the fixed zoo only exposes through the xla
+    flavor, and the main lever at bandwidth-bound message sizes.
+    """
+    multi_axis = len(topology.axes) >= 2 and topology.inter_size >= 1
+    out: List[Plan] = [flavor_plan("naive"), flavor_plan("flat"),
+                       flavor_plan("xla")]
+    if multi_axis:
+        out.append(flavor_plan("hierarchical"))
+        out.append(flavor_plan("two_dimensional"))
+    if topology.inter_size == 1:
+        out.append(flavor_plan("single_node"))
+    for wd in wire_dtypes:
+        out.append(Plan(name=f"flat_{wd}", packing="flat", wire_dtype=wd,
+                        stages=(_ar("all"),)))
+        if multi_axis:
+            # 2-D decomposition with the reduced wire only on the two
+            # ICI legs' payload; the DCN leg already carries 1/intra of
+            # the bytes.
+            out.append(Plan(
+                name=f"two_dimensional_{wd}", packing="flat", wire_dtype=wd,
+                stages=(Stage(op="reduce-scatter", scope="intra"),
+                        _ar("inter"),
+                        Stage(op="all-gather", scope="intra",
+                              lowering="masked-psum"))))
+    # De-duplicate by serialized form (xla with no wire == flat, etc.)
+    seen: Dict[str, Plan] = {}
+    for p in out:
+        key = repr((p.packing, p.wire_dtype,
+                    tuple(s.to_dict().items() for s in p.stages)))
+        seen.setdefault(key, p)
+    return list(seen.values())
+
+
+__all__ = ["FLAVOR_NAMES", "candidate_plans", "flavor_plan"]

@@ -77,6 +77,63 @@ def test_two_dimensional_scatter_small_inter_leg(devices):
     assert inter[0]["bytes"] <= full / 4 + 64, (inter, full)
 
 
+def test_hand_written_table_cross_check(devices):
+    """One-time cross-check of the RETIRED hand-written census table.
+
+    ``expected_kinds`` used to be this per-flavor lookup, maintained by
+    hand in ``analysis/rules.py``; it is now DERIVED from the flavor's
+    plan (``planner.plans.flavor_plan`` compiled statically through
+    ``planner.compiler.plan_census_kinds``).  This test embeds the old
+    table one last time and pins two facts:
+
+    1. On every configuration the old gate actually exercised
+       (flat family and single_node at any inter; hierarchical and
+       two_dimensional at ``inter >= 2``), the derived census agrees
+       exactly — the refactor changed the source of truth, not the spec.
+    2. At ``inter == 1`` the old hierarchical/two_dimensional branches
+       CONTRADICT compiled reality: XLA does not elide singleton-group
+       collectives, so the inter leg still compiles (single_node's
+       comment even said so).  The derived census is checked against the
+       compiled HLO here — the hand-written branches were simply wrong,
+       which is why the table is derived now.
+    """
+    old_table = {
+        "naive": lambda inter: ("all-reduce",),
+        "flat": lambda inter: ("all-reduce",),
+        "xla": lambda inter: ("all-reduce",),
+        "pure_nccl": lambda inter: ("all-reduce",),
+        "non_cuda_aware": lambda inter: ("all-reduce",),
+        "single_node": lambda inter: ("all-reduce", "all-reduce"),
+        "hierarchical": lambda inter: (
+            ("all-reduce", "all-reduce") if inter > 1
+            else ("all-reduce",)),
+        "two_dimensional": lambda inter: (
+            ("reduce-scatter", "all-reduce", "all-reduce") if inter > 1
+            else ("reduce-scatter", "all-reduce")),
+    }
+    # 1. agreement wherever the old gate ran
+    for flavor in ("naive", "flat", "xla", "pure_nccl", "non_cuda_aware",
+                   "single_node"):
+        for inter in (1, 2, 4):
+            assert expected_kinds(flavor, inter) == \
+                old_table[flavor](inter), (flavor, inter)
+    for flavor in ("hierarchical", "two_dimensional"):
+        for inter in (2, 4):
+            assert expected_kinds(flavor, inter) == \
+                old_table[flavor](inter), (flavor, inter)
+    # 2. the inter == 1 divergence, settled by the compiler
+    assert old_table["hierarchical"](1) != expected_kinds(
+        "hierarchical", 1)
+    ops = _ops_for("hierarchical", intra_size=8)   # inter leg of size 1
+    assert tuple(o["op"] for o in ops) == \
+        expected_kinds("hierarchical", 1) == \
+        ("all-reduce", "all-reduce"), ops
+    ops = _ops_for("two_dimensional", intra_size=8)
+    assert tuple(o["op"] for o in ops) == \
+        expected_kinds("two_dimensional", 1) == \
+        ("reduce-scatter", "all-reduce", "all-reduce"), ops
+
+
 def test_bench_census_delegates_to_shared_parser(devices):
     """``bench_allreduce._collective_ops`` (the artifact writer) is the
     shared analysis parser — same records, byte for byte, so the gate and

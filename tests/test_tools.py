@@ -58,9 +58,10 @@ def test_multichip_day1_dry_run():
     for step in ("tpu_smoke", "convergence ledger", "allreduce scaling",
                  "combiner/barrier split", "five BASELINE configs",
                  "ring attention", "multi-controller",
-                 "cmn-lint static preflight"):
+                 "cmn-lint static preflight", "perf gate",
+                 "collective-planner autotune gate"):
         assert step in out, f"runbook lost its '{step}' step:\n{out}"
-    assert out.count("DRY_RUN: not executed") >= 7, out
+    assert out.count("DRY_RUN: not executed") >= 9, out
     assert "artifact:" in out
     # the watchdog-knob preflight is hardware-free, so it runs (and must
     # pass) even under DRY_RUN — a hardware day must not discover that a

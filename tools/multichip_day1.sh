@@ -97,6 +97,23 @@ run 1 "$OUT/LM_BENCH_$ROUND.json" \
     "Transformer-LM bench (554M params, T=8192, flash kernels - the 52% MFU panel)" -- \
     bash -c "$PY_TPU benchmarks/bench_lm.py > '$OUT/LM_BENCH_$ROUND.json'"
 
+run 1 "$OUT/PERF_GATE_$ROUND.json" \
+    "perf gate: fresh bench artifacts vs checked-in budgets (tools/perf_budgets.json; >3% regression on any tracked throughput FAILS this leg)" -- \
+    $PY_TPU tools/perf_gate.py --budgets tools/perf_budgets.json \
+        --root "$OUT" --out "$OUT/PERF_GATE_$ROUND.json"
+
+# ---- collective planner: sweep -> autotune -> gate --------------------
+# Hardware-free (forced CPU mesh) so the planner pipeline is exercised
+# on every host; on a slice, re-run WITHOUT the env override to tune on
+# real ICI/DCN (docs/collective_planner.md).
+run 0 "$OUT/PLANNER_GATE_$ROUND.json" \
+    "collective-planner autotune gate: sweep candidate plans, build the plan table, require the tuned pick to beat the best fixed flavor somewhere" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_allreduce.py --sweep '$OUT/ALLREDUCE_SWEEP_$ROUND.json' \
+            --intra-size 4 --iters 10 --warmup 2 > /dev/null \
+        && $PY_TPU tools/perf_gate.py --planner '$OUT/ALLREDUCE_SWEEP_$ROUND.json' \
+            --table '$OUT/PLAN_TABLE_$ROUND.json' --out '$OUT/PLANNER_GATE_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

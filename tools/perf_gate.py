@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""perf_gate — fail loudly when a tracked benchmark regresses.
+
+Two modes, both exit nonzero on a gate failure so the runbook/CI leg
+that invokes them goes red instead of silently recording a slower repo:
+
+1. Budget check (default)::
+
+       python tools/perf_gate.py --budgets tools/perf_budgets.json
+
+   Reads the checked-in budgets file (one record per tracked metric:
+   artifact glob, dotted key path into its JSON, budget value) and
+   compares the newest matching artifact against the budget.  A metric
+   more than ``max_regression_pct`` (default 3%) BELOW budget fails the
+   gate; a missing artifact is reported and skipped (hardware artifacts
+   don't exist on a CPU-only host) unless ``--strict``.
+
+2. Planner gate::
+
+       python tools/perf_gate.py --planner SWEEP.json \
+           --table plan_table.json --out PLANNER_GATE.json
+
+   Consumes a ``bench_allreduce --sweep`` artifact (schema
+   ``allreduce_sweep/v1``), runs the autotuner
+   (``planner.autotune_from_rows``), writes the per-size plan table the
+   ``auto`` communicator loads, and PASSES only if the tuned selection
+   strictly beats the best single fixed flavor in at least one
+   (topology, dtype, size-bucket) cell — the "autotuning must pay for
+   itself" acceptance criterion.  The comparison rows land in the
+   ``--out`` JSON artifact.
+
+Wired into ``tools/multichip_day1.sh`` as the PERF_GATE and PLANNER
+legs; see docs/collective_planner.md.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGETS_SCHEMA = "perf_budgets/v1"
+PLANNER_GATE_SCHEMA = "planner_gate/v1"
+
+
+def _dig(doc, dotted):
+    """Resolve a dotted key path ('parsed.value') into a JSON doc."""
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(
+                f"key path {dotted!r} broke at {part!r} "
+                f"(have: {sorted(cur) if isinstance(cur, dict) else cur!r})")
+        cur = cur[part]
+    return float(cur)
+
+
+def check_budgets(args):
+    with open(args.budgets) as f:
+        budgets = json.load(f)
+    if budgets.get("schema") != BUDGETS_SCHEMA:
+        print(f"perf_gate: unsupported budgets schema "
+              f"{budgets.get('schema')!r} (want {BUDGETS_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    max_reg = float(args.max_regression_pct
+                    if args.max_regression_pct is not None
+                    else budgets.get("max_regression_pct", 3.0))
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rows = []
+    failed = 0
+    for m in budgets.get("metrics", []):
+        matches = sorted(glob.glob(os.path.join(root, m["artifact"])),
+                         key=os.path.getmtime)
+        row = {"name": m["name"], "artifact": m["artifact"],
+               "unit": m.get("unit"), "budget": float(m["budget"])}
+        if not matches:
+            row["status"] = "missing"
+            if args.strict:
+                failed += 1
+        else:
+            row["path"] = os.path.relpath(matches[-1], root)
+            try:
+                value = _dig(json.load(open(matches[-1])), m["key"])
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                row["status"] = f"unreadable: {e}"
+                failed += 1
+                rows.append(row)
+                continue
+            row["value"] = value
+            # all tracked metrics are higher-is-better throughputs
+            reg = (row["budget"] - value) / row["budget"] * 100.0
+            row["regression_pct"] = round(reg, 2)
+            if reg > max_reg:
+                row["status"] = "FAIL"
+                failed += 1
+            else:
+                row["status"] = "ok"
+        rows.append(row)
+        print(f"perf_gate {row['status']:>9} {row['name']}: "
+              f"value={row.get('value', '-')} budget={row['budget']} "
+              f"({row.get('regression_pct', '-')}% vs {max_reg}% allowed)",
+              file=sys.stderr)
+    report = {"schema": BUDGETS_SCHEMA, "max_regression_pct": max_reg,
+              "root": root, "metrics": rows,
+              "ok": failed == 0}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": report["ok"], "failed": failed,
+                      "checked": len(rows)}), flush=True)
+    return 0 if failed == 0 else 1
+
+
+def planner_gate(args):
+    from chainermn_tpu.planner import (
+        SWEEP_SCHEMA, autotune_from_rows, validate_sweep_rows)
+
+    with open(args.planner) as f:
+        sweep = json.load(f)
+    if sweep.get("schema") != SWEEP_SCHEMA:
+        print(f"perf_gate: unsupported sweep schema "
+              f"{sweep.get('schema')!r} (want {SWEEP_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    rows = sweep.get("rows", [])
+    validate_sweep_rows(rows)
+    table, comparison = autotune_from_rows(rows)
+    wins = [c for c in comparison
+            if c["speedup"] is not None and c["speedup"] > 1.0]
+    for c in comparison:
+        speedup = c["speedup"]
+        if speedup is None:
+            print(f"perf_gate      {c['topology']} {c['dtype']} "
+                  f"{c['bucket']}: no fixed baseline in sweep",
+                  file=sys.stderr)
+            continue
+        mark = "WIN " if speedup > 1.0 else "    "
+        print(f"perf_gate {mark} {c['topology']} {c['dtype']} "
+              f"{c['bucket']:>9}: tuned={c['tuned_plan']} "
+              f"({c['tuned_us']:.1f} us) vs best_fixed="
+              f"{c['best_fixed_plan']} ({c['best_fixed_us']:.1f} us) "
+              f"speedup={speedup:.3f}", file=sys.stderr)
+    ok = bool(wins)
+    table.meta.update({"sweep": os.path.basename(args.planner),
+                       "backend": sweep.get("backend"),
+                       "n_devices": sweep.get("n_devices")})
+    if args.table:
+        table.save(args.table)
+        print(f"perf_gate: plan table ({len(table.entries)} cells) "
+              f"-> {args.table}", file=sys.stderr)
+    artifact = {"schema": PLANNER_GATE_SCHEMA,
+                "sweep": os.path.basename(args.planner),
+                "backend": sweep.get("backend"),
+                "n_devices": sweep.get("n_devices"),
+                "topology": sweep.get("topology"),
+                "cells": comparison,
+                "tuned_wins": len(wins),
+                "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok, "tuned_wins": len(wins),
+                      "cells": len(comparison)}), flush=True)
+    if not ok:
+        print("perf_gate: FAIL — tuned table never beats the best fixed "
+              "flavor; autotuning is not paying for itself on this "
+              "topology", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--budgets", default=None, metavar="BUDGETS.json",
+                        help="budget-check mode: checked-in budgets file "
+                             f"(schema {BUDGETS_SCHEMA})")
+    parser.add_argument("--root", default=None,
+                        help="directory the budget artifact globs resolve "
+                             "under (default: repo root)")
+    parser.add_argument("--max-regression-pct", type=float, default=None,
+                        help="override the budgets file's allowed "
+                             "regression (default 3%%)")
+    parser.add_argument("--strict", action="store_true",
+                        help="budget mode: missing artifacts fail instead "
+                             "of being skipped")
+    parser.add_argument("--planner", default=None, metavar="SWEEP.json",
+                        help="planner-gate mode: bench_allreduce --sweep "
+                             "artifact to autotune and gate")
+    parser.add_argument("--table", default=None, metavar="TABLE.json",
+                        help="planner mode: write the tuned plan table "
+                             "here (load with create_communicator('auto', "
+                             "plan_table=...))")
+    parser.add_argument("--out", default=None, metavar="OUT.json",
+                        help="write the gate report/artifact JSON here")
+    args = parser.parse_args()
+    if bool(args.budgets) == bool(args.planner):
+        parser.error("pass exactly one of --budgets or --planner")
+    if args.planner:
+        return planner_gate(args)
+    return check_budgets(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
