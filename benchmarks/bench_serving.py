@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Serving benchmark: continuous vs static batching, open-loop arrivals.
+
+Replays ONE synthetic request trace (seeded prompt lengths + exponential
+inter-arrival gaps — open loop: arrivals don't wait for the server)
+through the :class:`chainermn_tpu.serving.InferenceEngine` twice — once
+with continuous admission, once with the classic static batch — and
+reports throughput (tokens/sec), time-to-first-token, and per-token
+latency percentiles for both.  The acceptance bar is baked in: the run
+FAILS (exit 1) unless continuous beats static on throughput at the same
+arrival rate.
+
+Wall-clock is host-side only (arrival bookkeeping and latency stamps);
+nothing traced reads time.  On the 8-device CPU mesh this validates the
+harness and the scheduling win; on a TPU slice the same command measures
+real serving throughput (``--tp`` shards the model over ICI).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python benchmarks/bench_serving.py --requests 16 --out SERVING.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Runnable from a fresh clone without `pip install -e .`.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_trace(args):
+    """The shared request trace: (arrival_offset_s, prompt, max_new)."""
+    rng = np.random.default_rng(args.seed)
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for t in arrivals:
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        prompt = list(map(int, rng.integers(1, args.vocab, size=n)))
+        # decode lengths vary per request (real traffic is heavy-tailed);
+        # the spread is exactly what continuous batching exploits — a
+        # static batch drains at the pace of its longest member
+        max_new = int(rng.integers(1, args.max_new + 1))
+        trace.append((float(t), prompt, max_new))
+    return trace
+
+
+def run_policy(policy, model, params, trace, args):
+    from chainermn_tpu.serving import InferenceEngine, ServingConfig
+
+    cfg = ServingConfig(page_size=args.page_size, num_pages=args.num_pages,
+                        max_seqs=args.max_seqs,
+                        chunk_tokens=args.chunk_tokens,
+                        max_pages_per_seq=args.max_pages_per_seq,
+                        policy=policy, tp_size=args.tp)
+    eng = InferenceEngine(model, params, cfg)
+    # warmup: compile the fused forward outside the timed window
+    eng.submit(trace[0][1], max_new_tokens=1)
+    eng.run_until_idle()
+    eng.completions.clear()
+
+    t0 = time.perf_counter()
+    pending = list(trace)
+    steps = 0
+    while pending or not eng.idle():
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            off, prompt, max_new = pending.pop(0)
+            eng.submit(prompt, max_new_tokens=max_new,
+                       arrival=t0 + off)
+        if eng.idle():
+            time.sleep(0.001)   # open loop: wait for the next arrival
+            continue
+        eng.step()
+        steps += 1
+        if steps > args.max_steps:
+            raise RuntimeError(
+                f"[{policy}] still busy after {args.max_steps} steps")
+    wall = time.perf_counter() - t0
+
+    comps = eng.completions
+    n_tokens = sum(len(c.tokens) for c in comps)
+    ttfts = [c.ttft for c in comps if c.token_times]
+    per_token = []
+    for c in comps:
+        per_token.extend(np.diff(c.token_times))
+    pct = lambda a, q: float(np.percentile(a, q)) if len(a) else None
+    return {
+        "policy": policy,
+        "requests": len(comps),
+        "generated_tokens": n_tokens,
+        "steps": steps,
+        "wall_s": wall,
+        "tokens_per_sec": n_tokens / wall,
+        "ttft_s": {"mean": float(np.mean(ttfts)),
+                   "p50": pct(ttfts, 50), "p99": pct(ttfts, 99)},
+        "per_token_s": {"mean": float(np.mean(per_token))
+                        if per_token else None,
+                        "p50": pct(per_token, 50),
+                        "p99": pct(per_token, 99)},
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--rate", type=float, default=200.0,
+                        help="open-loop arrival rate (requests/sec); the "
+                             "default saturates the CPU-mesh toy model "
+                             "so the run measures scheduling, not idle "
+                             "arrival gaps")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-prompt", type=int, default=4)
+    parser.add_argument("--max-prompt", type=int, default=24)
+    parser.add_argument("--max-new", type=int, default=24)
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--max-seqs", type=int, default=4)
+    parser.add_argument("--chunk-tokens", type=int, default=8)
+    parser.add_argument("--page-size", type=int, default=8)
+    parser.add_argument("--num-pages", type=int, default=64)
+    parser.add_argument("--max-pages-per-seq", type=int, default=8)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel ways (devices)")
+    parser.add_argument("--max-steps", type=int, default=100000)
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="write the bench_serving/v1 JSON artifact "
+                             "(tools/perf_gate.py --budgets reads "
+                             "continuous.tokens_per_sec)")
+    parser.add_argument("--metrics", default=None, metavar="PATH",
+                        help="append records + a registry snapshot to "
+                             "this metrics JSONL (render with "
+                             "tools/obs_report.py --serving)")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    if args.metrics:
+        from chainermn_tpu import observability as obs
+        obs.enable()
+
+    model = TransformerLM(vocab=args.vocab, d_model=args.d_model,
+                          n_layers=args.n_layers, n_heads=args.n_heads,
+                          max_len=args.max_pages_per_seq * args.page_size,
+                          attention_impl="xla")
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 4), jnp.int32))
+    trace = build_trace(args)
+
+    results = {p: run_policy(p, model, params, trace, args)
+               for p in ("continuous", "static")}
+    speedup = (results["continuous"]["tokens_per_sec"]
+               / results["static"]["tokens_per_sec"])
+    report = {
+        "schema": "bench_serving/v1",
+        "config": {k: v for k, v in vars(args).items()
+                   if k not in ("out", "metrics")},
+        "devices": jax.device_count(),
+        "continuous": results["continuous"],
+        "static": results["static"],
+        "speedup": speedup,
+    }
+    print(json.dumps(report, indent=1))
+    if args.out:
+        from chainermn_tpu.observability.sinks import atomic_write_json
+        atomic_write_json(args.out, report)
+    if args.metrics:
+        from chainermn_tpu.observability import get_registry
+        from chainermn_tpu.observability.sinks import (append_jsonl,
+                                                       write_snapshot_jsonl)
+        for policy in ("continuous", "static"):
+            append_jsonl(args.metrics, {"kind": "bench_serving",
+                                        **results[policy]})
+        write_snapshot_jsonl(args.metrics, get_registry().snapshot())
+
+    if speedup <= 1.0:
+        print(f"FAIL: continuous batching did not beat static "
+              f"({results['continuous']['tokens_per_sec']:.1f} vs "
+              f"{results['static']['tokens_per_sec']:.1f} tok/s)",
+              file=sys.stderr)
+        return 1
+    print(f"continuous beats static: {speedup:.2f}x "
+          f"({results['continuous']['tokens_per_sec']:.1f} vs "
+          f"{results['static']['tokens_per_sec']:.1f} tok/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

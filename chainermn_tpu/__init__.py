@@ -56,7 +56,16 @@ _EXPORTS = {
     "available_compressors": "chainermn_tpu.compression",
     "create_multi_node_evaluator": "chainermn_tpu.extensions",
     "AllreducePersistent": "chainermn_tpu.extensions",
+    "consolidate_fsdp_checkpoint": "chainermn_tpu.extensions",
     "create_multi_node_checkpointer": "chainermn_tpu.extensions",
+    # continuous-batching inference (beyond-reference subsystem)
+    "AdmissionScheduler": "chainermn_tpu.serving",
+    "InferenceEngine": "chainermn_tpu.serving",
+    "KvCache": "chainermn_tpu.serving",
+    "PageAllocator": "chainermn_tpu.serving",
+    "ServingConfig": "chainermn_tpu.serving",
+    "load_inference_params": "chainermn_tpu.serving",
+    "paged_attention": "chainermn_tpu.serving",
     "create_multi_node_iterator": "chainermn_tpu.iterators",
     "create_synchronized_iterator": "chainermn_tpu.iterators",
     "MultiNodeBatchNormalization": "chainermn_tpu.links",

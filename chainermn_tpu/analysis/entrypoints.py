@@ -153,6 +153,47 @@ def lint_long_context(rules: Optional[Sequence[str]] = None,
         hlo=hlo, rules=rules, raise_on_error=False)]
 
 
+def _serving_decode_target(tp: int = 2):
+    """The serving engine's fused prefill+decode forward at toy size,
+    tensor-parallel over 2 devices — the jitted program every serving
+    step replays.  The interesting schedule is the tp > 1 one: Megatron
+    row-parallel psums over the ``"tp"`` axis inside shard_map (tp=1
+    compiles to a collective-free program)."""
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import InferenceEngine, ServingConfig
+
+    model = TransformerLM(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          max_len=64, attention_impl="xla")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+    cfg = ServingConfig(page_size=4, num_pages=8, max_seqs=2,
+                        chunk_tokens=4, max_pages_per_seq=4, tp_size=tp)
+    eng = InferenceEngine(model, params, cfg)
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.scheduler.apply_plan(eng.scheduler.build_plan())
+    batch = eng.scheduler.step_batch()
+    args = (eng._params, eng._ck, eng._cv,
+            jnp.asarray(batch["page_table"]),
+            jnp.asarray(batch["tokens"]), jnp.asarray(batch["pos0"]),
+            jnp.asarray(batch["n_new"]))
+    return eng._fwd, args
+
+
+def lint_serving_decode(rules: Optional[Sequence[str]] = None,
+                        hlo: bool = True) -> List[LintReport]:
+    """One report for the serving decode step (tp=2).  Lockstep serving
+    has the same SPMD obligation as training — every controller must
+    trace the identical schedule from the broadcast plan — so the
+    schedule-desync variants run the builder twice, exactly as a rank
+    pair would.  No communicator object is in play (the engine drives
+    shard_map directly), so the comm-bound rules report as skipped."""
+    step, args = _serving_decode_target()
+    return [lint_step(
+        step, *args,
+        name="serving/decode[tp2]",
+        variants={"rank0": (step,) + args, "rank1": (step,) + args},
+        hlo=hlo, rules=rules, raise_on_error=False)]
+
+
 ENTRY_POINTS: Dict[str, dict] = {
     "examples/mnist": {
         "fn": lint_mnist,
@@ -165,6 +206,13 @@ ENTRY_POINTS: Dict[str, dict] = {
         "flavors": None,
         "help": "ring-attention sequence-parallel LM step (schedule, "
                 "captured-constant, donation, async rules)",
+    },
+    "serving/decode": {
+        "fn": lint_serving_decode,
+        "flavors": None,
+        "help": "serving engine fused prefill+decode forward, tp=2 "
+                "Megatron shard_map (schedule, captured-constant, "
+                "async rules)",
     },
 }
 
@@ -187,4 +235,4 @@ def lint_entry_point(name: str, flavors: Optional[Sequence[str]] = None,
 
 
 __all__ = ["ENTRY_POINTS", "MNIST_FLAVORS", "lint_entry_point",
-           "lint_long_context", "lint_mnist"]
+           "lint_long_context", "lint_mnist", "lint_serving_decode"]

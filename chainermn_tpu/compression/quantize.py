@@ -275,5 +275,43 @@ def wire_bits_per_param(comp, length: int, world_size: int = 1) -> float:
     return item_bits * mp / max(int(length), 1)
 
 
+# -- weight quantization (serving) -------------------------------------------
+# Unlike the gradient codecs above (delayed pow2 scales, in-wire
+# summation), inference weights are quantized ONCE, offline, with exact
+# per-channel amax scales — no EF, no wire-sum overflow budget.
+
+
+def quantize_per_channel_int8(w, channel_axis: int = -1):
+    """Symmetric per-channel int8: ``codes = round(w / s)`` with
+    ``s = amax / 127`` per slice along ``channel_axis`` (the output
+    channel for a ``[in, out]`` kernel).  Returns ``(codes int8,
+    scale f32)`` with ``scale`` shaped to broadcast against ``codes``.
+    All-zero channels get scale 1 (codes are all zero anyway)."""
+    w = jnp.asarray(w, jnp.float32)
+    axes = tuple(a for a in range(w.ndim)
+                 if a != channel_axis % w.ndim)
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def quantize_per_tensor_int8(w):
+    """One scale for the whole tensor — the baseline the per-channel
+    property test beats (``tests/test_compression.py``)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_int8(codes, scale):
+    """Inverse of either weight quantizer (scale broadcasts)."""
+    return codes.astype(jnp.float32) * scale
+
+
 __all__ = ["Fp8Compressor", "Int8Compressor", "QUANTIZERS",
-           "is_quantizing", "wire_bits_per_param"]
+           "dequantize_int8", "is_quantizing",
+           "quantize_per_channel_int8", "quantize_per_tensor_int8",
+           "wire_bits_per_param"]

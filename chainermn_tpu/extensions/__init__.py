@@ -7,6 +7,7 @@ from chainermn_tpu.extensions.allreduce_persistent import (
     allreduce_persistent,
 )
 from chainermn_tpu.extensions.checkpoint import (
+    consolidate_fsdp_checkpoint,
     create_multi_node_checkpointer,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "make_eval_fn",
     "AllreducePersistent",
     "allreduce_persistent",
+    "consolidate_fsdp_checkpoint",
     "create_multi_node_checkpointer",
 ]

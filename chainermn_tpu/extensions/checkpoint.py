@@ -147,9 +147,13 @@ class _MultiNodeCheckpointer:
                     f"checkpoint {where} was saved with FSDP "
                     f"world_size={saved['world_size']} but this world has "
                     f"comm.size={self.comm.size}; shard layouts are bound "
-                    f"to the world size — restore on a matching world, or "
+                    f"to the world size — restore on a matching world, "
                     f"export with fsdp_full_params and re-shard with "
-                    f"fsdp_init (the cross-size/cross-mode path)")
+                    f"fsdp_init (the cross-size/cross-mode path), or, for "
+                    f"inference, consolidate on the training world with "
+                    f"consolidate_fsdp_checkpoint and load the full "
+                    f"params with chainermn_tpu.serving.weights."
+                    f"load_inference_params (world-size-free)")
             if "num_buckets" in saved \
                     and saved["num_buckets"] != live["num_buckets"]:
                 raise ValueError(
@@ -307,6 +311,51 @@ def ocp_utils_to_abstract(x):
     if hasattr(x, "sharding") and hasattr(x, "dtype"):
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
     return x
+
+
+def consolidate_fsdp_checkpoint(state, metas):
+    """Consolidate every FSDP-sharded sub-state of a (restored) training
+    state into its full replicated parameter pytree — the world-size-free
+    export the serving weight loader consumes
+    (:func:`chainermn_tpu.serving.weights.load_inference_params`).
+
+    ``state`` is a training state tree (dicts/lists/tuples) holding one
+    or more :class:`~chainermn_tpu.parallel.fsdp.FsdpState` nodes —
+    typically the tree just restored by ``checkpointer.resume`` on the
+    *training* world (shard layouts are bound to the world size; resume
+    on a mismatched world refuses, naming this path).  ``metas`` is the
+    matching :class:`~chainermn_tpu.parallel.fsdp.FsdpMeta` — or a
+    sequence of them, one per FsdpState in ``iter_fsdp_states`` order.
+    Returns the tree with each FsdpState replaced by its full parameter
+    pytree (``fsdp_full_params`` — no collective needed); the optimizer
+    inner state and any error-feedback compression state are dropped
+    (inference has no use for either).
+    """
+    from chainermn_tpu.parallel.fsdp import (FsdpMeta, FsdpState,
+                                             fsdp_full_params,
+                                             iter_fsdp_states)
+
+    metas = [metas] if isinstance(metas, FsdpMeta) else list(metas)
+    n_states = sum(1 for _ in iter_fsdp_states(state))
+    if n_states != len(metas):
+        raise ValueError(
+            f"state tree holds {n_states} FsdpState(s) but {len(metas)} "
+            f"FsdpMeta(s) were given — pass one meta per sharded "
+            f"sub-state, in iter_fsdp_states order")
+    it = iter(metas)
+
+    def walk(node):
+        if isinstance(node, FsdpState):
+            return fsdp_full_params(node, next(it))
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, tuple) and not hasattr(node, "_fields"):
+            return tuple(walk(v) for v in node)
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(state)
 
 
 def create_multi_node_checkpointer(communicator, path: str,

@@ -20,9 +20,11 @@ f32 parameters (same conventions as the image zoo).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -61,7 +63,20 @@ class Block(nn.Module):
     ``moe_axis``; the load-balancing aux loss and overflow fraction are
     sowed into the ``"moe_stats"`` collection (retrieve with
     ``mutable=["moe_stats"]`` and add ``aux_weight * sum(aux_loss)`` to the
-    training loss)."""
+    training loss).
+
+    Two inference extensions (``chainermn_tpu/serving``):
+
+    * ``attend=`` (call-time) — replaces the built-in causal attention
+      with an external callback ``attend(q, k, v) -> [B, T, H_local, Dh]``
+      that owns masking and any KV-cache read/write (decode mode).  The
+      callback sees GROUPED kv heads (no GQA expansion).
+    * ``tp_size``/``tp_axis`` — Megatron tensor parallelism: the block
+      computes ``n_heads / tp_size`` local heads from column-sliced
+      qkv/up kernels and psums the row-parallel proj/down outputs over
+      ``tp_axis`` (apply inside shard_map with params sliced by
+      :func:`chainermn_tpu.serving.weights.shard_params_tp`, which also
+      pre-divides the row-parallel biases by ``tp_size``)."""
 
     n_heads: int
     attention_impl: str = "xla"
@@ -72,37 +87,52 @@ class Block(nn.Module):
     moe_top_k: int = 1
     moe_axis: Any = "ep"
     moe_capacity: Optional[int] = None
+    tp_size: int = 1              # tensor-parallel ways (serving)
+    tp_axis: Any = None           # mesh axis for the row-parallel psums
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, attend=None):
         d_model = x.shape[-1]
         head_dim = d_model // self.n_heads
         n_kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % self.tp_size or n_kv % self.tp_size:
+            raise ValueError(
+                f"tp_size ({self.tp_size}) must divide n_heads "
+                f"({self.n_heads}) and n_kv_heads ({n_kv})")
+        n_local = self.n_heads // self.tp_size
+        n_kv_local = n_kv // self.tp_size
+        d_local = n_local * head_dim
         dense = lambda f, name: nn.Dense(
             f, dtype=self.dtype, param_dtype=jnp.float32, name=name)
         ln = lambda name: nn.LayerNorm(dtype=self.dtype,
                                        param_dtype=jnp.float32, name=name)
+        row_psum = (lambda y: jax.lax.psum(y, self.tp_axis)) \
+            if self.tp_axis is not None and self.tp_size > 1 else (lambda y: y)
 
         h = ln("ln_attn")(x)
-        d_kv = n_kv * head_dim
-        qkv = dense(d_model + 2 * d_kv, "qkv")(h)
-        q = qkv[..., :d_model]
-        k = qkv[..., d_model:d_model + d_kv]
-        v = qkv[..., d_model + d_kv:]
-        q = q.reshape(h.shape[:-1] + (self.n_heads, head_dim))
-        k = k.reshape(h.shape[:-1] + (n_kv, head_dim))
-        v = v.reshape(h.shape[:-1] + (n_kv, head_dim))
-        if n_kv != self.n_heads and self.attention_impl not in (
-                "flash", "ring_flash"):
-            # the fused kernel reads grouped kv natively (and under
-            # ring_flash the GROUPED blocks rotate the ring — 1/grp the
-            # ppermute bytes, GQA's whole point); other impls see the
-            # expanded heads
-            k = jnp.repeat(k, self.n_heads // n_kv, axis=-2)
-            v = jnp.repeat(v, self.n_heads // n_kv, axis=-2)
-        out = _attend(self.attention_impl, self.axis_name, q, k, v,
-                      causal=True)
-        x = x + dense(d_model, "proj")(out.reshape(h.shape))
+        d_kv = n_kv_local * head_dim
+        qkv = dense(d_local + 2 * d_kv, "qkv")(h)
+        q = qkv[..., :d_local]
+        k = qkv[..., d_local:d_local + d_kv]
+        v = qkv[..., d_local + d_kv:]
+        q = q.reshape(h.shape[:-1] + (n_local, head_dim))
+        k = k.reshape(h.shape[:-1] + (n_kv_local, head_dim))
+        v = v.reshape(h.shape[:-1] + (n_kv_local, head_dim))
+        if attend is not None:
+            out = attend(q, k, v)
+        else:
+            if n_kv != self.n_heads and self.attention_impl not in (
+                    "flash", "ring_flash"):
+                # the fused kernel reads grouped kv natively (and under
+                # ring_flash the GROUPED blocks rotate the ring — 1/grp the
+                # ppermute bytes, GQA's whole point); other impls see the
+                # expanded heads
+                k = jnp.repeat(k, n_local // n_kv_local, axis=-2)
+                v = jnp.repeat(v, n_local // n_kv_local, axis=-2)
+            out = _attend(self.attention_impl, self.axis_name, q, k, v,
+                          causal=True)
+        x = x + row_psum(dense(d_model, "proj")(
+            out.reshape(h.shape[:-1] + (d_local,))))
 
         h = ln("ln_mlp")(x)
         if self.moe_experts:
@@ -118,8 +148,8 @@ class Block(nn.Module):
                      stats["overflow_fraction"])
             self.sow("moe_stats", "expert_load", stats["expert_load"])
             return x + y
-        h = nn.gelu(dense(4 * d_model, "up")(h))
-        return x + dense(d_model, "down")(h)
+        h = nn.gelu(dense(4 * d_model // self.tp_size, "up")(h))
+        return x + row_psum(dense(d_model, "down")(h))
 
 
 class TransformerLM(nn.Module):
@@ -128,6 +158,14 @@ class TransformerLM(nn.Module):
     With ``attention_impl="ring"``/``"ulysses"``, apply inside an SPMD
     region (``shard_map``) with ``tokens`` sharded [B, T/P] on
     ``axis_name`` — positions are global via ``pos_offset``.
+
+    Decode mode (``chainermn_tpu/serving``): ``pos_offset`` may be a
+    ``[B]`` int32 vector — each sequence of the batch sits at its own
+    global position (its KV-cache length) — and ``attend=`` installs a
+    per-layer attention callback ``attend(layer, q, k, v)`` that owns
+    masking and cache read/write.  ``tp_size``/``tp_axis`` shard every
+    block Megatron-style (see :class:`Block`); embeddings, layer norms
+    and the output head stay replicated.
     """
 
     vocab: int
@@ -143,9 +181,11 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 1
     moe_axis: Any = "ep"
     moe_capacity: Optional[int] = None
+    tp_size: int = 1              # tensor-parallel ways (serving)
+    tp_axis: Any = None
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, attend=None):
         if self.d_model % self.n_heads:
             raise ValueError(
                 f"n_heads ({self.n_heads}) must divide d_model "
@@ -157,16 +197,24 @@ class TransformerLM(nn.Module):
                 f"n_heads ({self.n_heads})")
         x = nn.Embed(self.vocab, self.d_model, param_dtype=jnp.float32,
                      dtype=self.dtype, name="tok_emb")(tokens)
+        off = jnp.asarray(pos_offset, jnp.int32)
+        if off.ndim == 0:                      # shared offset: [T] positions
+            positions = off + jnp.arange(tokens.shape[-1])
+        else:                                  # per-sequence: [B, T]
+            positions = off[:, None] + jnp.arange(tokens.shape[-1])[None, :]
         pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,
-                       dtype=self.dtype, name="pos_emb")(
-            pos_offset + jnp.arange(tokens.shape[-1]))
+                       dtype=self.dtype, name="pos_emb")(positions)
         x = x + pos
         for i in range(self.n_layers):
+            blk_attend = None if attend is None else functools.partial(
+                attend, i)
             x = Block(self.n_heads, self.attention_impl, self.axis_name,
                       self.dtype, n_kv_heads=self.n_kv_heads,
                       moe_experts=self.moe_experts,
                       moe_top_k=self.moe_top_k, moe_axis=self.moe_axis,
-                      moe_capacity=self.moe_capacity, name=f"block_{i}")(x)
+                      moe_capacity=self.moe_capacity,
+                      tp_size=self.tp_size, tp_axis=self.tp_axis,
+                      name=f"block_{i}")(x, attend=blk_attend)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_f")(x)
         logits = nn.Dense(self.vocab, dtype=self.dtype,

@@ -275,8 +275,11 @@ def _forward(q, k, v, qseg, kseg, seed, offs, causal, sm_scale, block_q,
         ins.append(seed.reshape(1, 1))
         in_specs.append(pl.BlockSpec((1, 1), lambda i, j, kk: (0, 0), **kw))
     if has_offsets:
-        ins.append(offs.reshape(1, 2))
-        in_specs.append(pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0), **kw))
+        # offs is [B, 2] (per-sequence global positions); each program
+        # reads its batch row — a [1, 2] block, like the seg-id vectors.
+        ins.append(offs)
+        in_specs.append(
+            pl.BlockSpec((1, 2), lambda i, j, kk: (i // h, 0), **kw))
     # Inside shard_map the outputs must carry the inputs' varying-axes
     # metadata (vma) so the kernel composes with sequence parallelism.
     out_shape = [_shape_like(qf, (b * h, tq, d), q.dtype),
@@ -485,8 +488,8 @@ def _pallas_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     seed_in = ([] if dropout_rate == 0.0 else [seed.reshape(1, 1)])
     seed_spec = ([] if dropout_rate == 0.0 else
                  [pl.BlockSpec((1, 1), lambda i, j, kk: (0, 0), **kw)])
-    offs_in = ([offs.reshape(1, 2)] if has_offsets else [])
-    offs_spec = ([pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0), **kw)]
+    offs_in = ([offs] if has_offsets else [])
+    offs_spec = ([pl.BlockSpec((1, 2), lambda i, j, kk: (i // h, 0), **kw)]
                  if has_offsets else [])
 
     # dk/dv: grid (bh, k-tile, q-tile) — q/g/lse/delta stream over the
@@ -606,18 +609,26 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
         kT, vT = rep(kT), rep(vT)
     lseT = lse.reshape(b, h, tq)  # lse arrives [B*H, 1, Tq]
     glseT = g_lse.reshape(b, h, tq) if g_lse is not None else None
-    goff_q = offs[0] if offs is not None else 0
-    goff_k = offs[1] if offs is not None else 0
-    q_pos = goff_q + jnp.arange(tq)
+    # offs is [B, 2] (per-sequence offsets); broadcast as [B, 1, T|S, 1]
+    # planes so the mask/dropout math matches the per-program scalars the
+    # Pallas kernels read
+    if offs is not None:
+        goff_q = offs[:, 0].reshape(b, 1, 1, 1)
+        goff_k = offs[:, 1].reshape(b, 1, 1, 1)
+    else:
+        goff_q = goff_k = jnp.zeros((1, 1, 1, 1), jnp.int32)
+    q_pos = goff_q + jnp.arange(tq).reshape(1, 1, tq, 1)   # [B|1,1,T,1]
     bh_idx = jnp.arange(b * h).reshape(b, h, 1, 1)
     D = (gT * oT).sum(-1)                                  # [B, H, T]
     inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
+    def k_pos_tile(j):
+        return goff_k + (j * bk + jnp.arange(bk)).reshape(1, 1, 1, bk)
+
     def tile_mask(j):
         mask = None
         if causal:
-            mask = (q_pos[:, None] >=
-                    (goff_k + j * bk + jnp.arange(bk))[None, :])[None, None]
+            mask = q_pos >= k_pos_tile(j)                  # [B|1,1,T,S]
         if qseg is not None:
             kseg_j = jax.lax.dynamic_slice_in_dim(kseg, j * bk, bk, axis=1)
             m2 = (qseg[:, None, :, None] == kseg_j[:, None, None, :])
@@ -627,9 +638,8 @@ def _blockwise_backward(q, k, v, out, lse, qseg, kseg, seed, offs, g, g_lse,
     def keep(j):
         if dropout_rate == 0.0:
             return None
-        k_pos = (goff_k + j * bk + jnp.arange(bk))[None, None, None, :]
         return _keep_mask(seed.astype(jnp.uint32), bh_idx,
-                          q_pos[None, None, :, None], k_pos, dropout_rate)
+                          q_pos, k_pos_tile(j), dropout_rate)
 
     def grad_fold(dq, j):
         kb = jax.lax.dynamic_slice_in_dim(kT, j * bk, bk, axis=2)
@@ -782,9 +792,11 @@ def flash_attention(q, k, v, causal: bool = False,
     * ``dropout_rate`` + ``dropout_seed`` — attention dropout; the seed
       is a traced uint32 scalar (vary it per training step).
     * ``q_offset`` / ``kv_offset`` — global positions of the first local
-      row (traced int scalars); the causal mask and the dropout hash use
-      global positions, so blocks of a longer sequence (ring attention)
-      mask consistently.
+      row: traced int scalars (shared by the batch — ring attention's
+      blocks of a longer sequence) or ``[B]`` int32 vectors giving every
+      sequence its own offset (decode over a paged KV cache, where each
+      batch row sits at a different cache length).  The causal mask and
+      the dropout hash both use global positions.
     * ``return_lse`` — also return the per-row logsumexp [B, H, T]
       (float32; fully-masked rows hold the sentinel 1e30).  The lse is
       DIFFERENTIABLE: its cotangent adds ``a_ij * g_lse_i`` to the score
@@ -810,9 +822,25 @@ def flash_attention(q, k, v, causal: bool = False,
     else:
         dropout_seed = None
     if (q_offset is not None) or (kv_offset is not None):
-        offs = jnp.stack([
-            jnp.asarray(0 if q_offset is None else q_offset, jnp.int32),
-            jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32)])
+        # offsets ride as one [B, 2] int32 array: column 0 = q, column 1 =
+        # kv.  Scalars broadcast over the batch (ring attention's shared
+        # block offsets); [B] arrays give every sequence its own global
+        # position — decode-over-a-paged-cache, where each row of the
+        # batch sits at a different cache length.
+        b = q.shape[0]
+
+        def _off_vec(o, label):
+            o = jnp.asarray(0 if o is None else o, jnp.int32)
+            if o.ndim == 0:
+                return jnp.broadcast_to(o, (b,))
+            if o.shape != (b,):
+                raise ValueError(
+                    f"{label} must be a scalar or a [batch] vector; got "
+                    f"shape {o.shape} for batch {b}")
+            return o
+
+        offs = jnp.stack([_off_vec(q_offset, "q_offset"),
+                          _off_vec(kv_offset, "kv_offset")], axis=1)
     else:
         offs = None
     # cross-attention supported: Tq (from q) and Tkv (from k/v) may

@@ -1,0 +1,44 @@
+"""Continuous-batching inference on the mesh stack.
+
+:mod:`~chainermn_tpu.serving.kv_cache` — paged KV cache + deterministic
+page allocator; :mod:`~chainermn_tpu.serving.scheduler` — lockstep
+admission scheduling (continuous or static);
+:mod:`~chainermn_tpu.serving.engine` — the fused prefill+decode step
+loop; :mod:`~chainermn_tpu.serving.weights` — checkpoint consolidation,
+int8 weight quantization, multicast broadcast, TP slicing.  See
+``docs/serving.md``.
+"""
+
+from chainermn_tpu.serving.engine import (Completion, InferenceEngine,
+                                          ServingConfig, StepResult)
+from chainermn_tpu.serving.kv_cache import (KvCache, PageAllocator,
+                                            gather_kv, init_kv_cache,
+                                            paged_attention, write_kv)
+from chainermn_tpu.serving.scheduler import AdmissionScheduler, Request
+from chainermn_tpu.serving.weights import (broadcast_inference_params,
+                                           dequantize_inference_params,
+                                           load_inference_params,
+                                           quantize_inference_params,
+                                           shard_params_tp,
+                                           weights_multicast_plan)
+
+__all__ = [
+    "AdmissionScheduler",
+    "Completion",
+    "InferenceEngine",
+    "KvCache",
+    "PageAllocator",
+    "Request",
+    "ServingConfig",
+    "StepResult",
+    "broadcast_inference_params",
+    "dequantize_inference_params",
+    "gather_kv",
+    "init_kv_cache",
+    "load_inference_params",
+    "paged_attention",
+    "quantize_inference_params",
+    "shard_params_tp",
+    "weights_multicast_plan",
+    "write_kv",
+]

@@ -1,0 +1,324 @@
+"""Continuous-batching inference engine on the mesh stack.
+
+One fixed-shape jitted forward serves every step: each of the ``B``
+slots contributes up to ``S = chunk_tokens`` tokens — a prefill chunk, a
+single decode token, or nothing (idle/finished slots write to the trash
+page and their logits are ignored) — so prefill and decode FUSE into one
+batched forward that never recompiles.  The step loop is:
+
+1. rank 0 builds the admission plan (retire finished, pack waiting
+   requests into free pages) and broadcasts it over the DCN control
+   plane (:mod:`chainermn_tpu.runtime.control_plane`) so every
+   controller applies the identical plan — lockstep by construction;
+2. the fused forward writes the step's K/V into the paged cache, runs
+   cache-offset-aware causal flash attention per layer, and greedily
+   samples each slot's last valid position;
+3. host state advances: sampled tokens append to their sequences,
+   finished sequences retire next step.
+
+With ``tp_size > 1`` the forward runs inside ``shard_map`` over a
+``"tp"`` mesh axis: params are Megatron-sliced
+(:func:`chainermn_tpu.serving.weights.shard_params_tp`), the KV cache is
+sharded over its kv heads, and the blocks psum their row-parallel
+outputs (:class:`chainermn_tpu.models.transformer.Block`), so the logits
+— and therefore the greedy samples — are replicated across the axis.
+
+Wall-clock is only ever read on the host (latency bookkeeping); nothing
+traced depends on time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.serving import kv_cache as _kv
+from chainermn_tpu.serving.scheduler import AdmissionScheduler
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine knobs (cache sizing is ``docs/serving.md``'s main topic)."""
+
+    page_size: int = 16           # tokens per KV page
+    num_pages: int = 64           # allocatable pages (excl. trash)
+    max_seqs: int = 4             # batch slots B
+    chunk_tokens: int = 8         # S: prefill chunk / step token budget
+    max_pages_per_seq: int = 8    # page-table width (max ctx / page_size)
+    eos_id: Optional[int] = None
+    policy: str = "continuous"    # or "static" (benchmark baseline)
+    tp_size: int = 1              # tensor-parallel ways
+    cache_dtype: Any = jnp.float32
+    keep_logits: bool = False     # stash last-position logits per step
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request (rank 0 carries the timing fields)."""
+
+    rid: int
+    prompt_len: int
+    tokens: List[int]
+    arrival: float = 0.0
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def ttft(self) -> float:
+        return self.token_times[0] - self.arrival if self.token_times \
+            else float("nan")
+
+
+@dataclasses.dataclass
+class StepResult:
+    step: int
+    plan: dict
+    emitted: list                  # [(rid, token, n_generated)]
+    completed: List[Completion]
+    ran_forward: bool
+    last_logits: Optional[np.ndarray] = None   # [B, vocab] (keep_logits)
+    n_new: Optional[np.ndarray] = None
+
+
+class InferenceEngine:
+    """``submit()`` on rank 0, then ``step()`` in lockstep on every rank
+    (or :meth:`run_until_idle` on a single controller)."""
+
+    def __init__(self, model, params, config: ServingConfig, *,
+                 plane=None):
+        from chainermn_tpu.observability import flight_recorder as _flight
+        from chainermn_tpu.observability.registry import (enabled,
+                                                          get_registry)
+        from chainermn_tpu.runtime.control_plane import get_control_plane
+
+        cfg = config
+        self.cfg = cfg
+        self.plane = plane if plane is not None else get_control_plane()
+        self.model = model
+        n_kv = model.n_kv_heads or model.n_heads
+        head_dim = model.d_model // model.n_heads
+        max_ctx = cfg.max_pages_per_seq * cfg.page_size
+        if max_ctx > model.max_len:
+            raise ValueError(
+                f"cache reach ({cfg.max_pages_per_seq} pages x "
+                f"{cfg.page_size}) exceeds the model's max_len "
+                f"({model.max_len})")
+        self.scheduler = AdmissionScheduler(
+            max_seqs=cfg.max_seqs, page_size=cfg.page_size,
+            num_pages=cfg.num_pages,
+            max_pages_per_seq=cfg.max_pages_per_seq,
+            chunk_tokens=cfg.chunk_tokens, eos_id=cfg.eos_id,
+            policy=cfg.policy)
+
+        tp = cfg.tp_size
+        if tp > 1:
+            from chainermn_tpu.serving.weights import shard_params_tp
+
+            if n_kv % tp:
+                raise ValueError(
+                    f"tp_size ({tp}) must divide n_kv_heads ({n_kv})")
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tp_size {tp} exceeds the {len(devs)} visible "
+                    f"devices")
+            self._mesh = jax.sharding.Mesh(np.array(devs[:tp]), ("tp",))
+            self._model_tp = model.clone(tp_size=tp, tp_axis="tp")
+            # Re-place everything onto THIS engine's tp mesh: params may
+            # arrive committed elsewhere (e.g. the run_spmd output of
+            # broadcast_inference_params lives on the communicator's
+            # full-device mesh), and jit refuses mixed device sets.
+            tp_sharding = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec("tp"))
+            self._params = jax.device_put(shard_params_tp(
+                params, tp, n_heads=model.n_heads, n_kv_heads=n_kv),
+                tp_sharding)
+            cache = _kv.init_kv_cache(
+                model.n_layers, cfg.num_pages, cfg.page_size,
+                n_kv // tp, head_dim, cfg.cache_dtype)
+            stack_tp = lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (tp,) + x.shape), tp_sharding)
+            self._ck, self._cv = stack_tp(cache.k), stack_tp(cache.v)
+        else:
+            self._mesh = None
+            self._model_tp = model
+            self._params = params
+            cache = _kv.init_kv_cache(
+                model.n_layers, cfg.num_pages, cfg.page_size,
+                n_kv, head_dim, cfg.cache_dtype)
+            self._ck, self._cv = cache.k, cache.v
+        self._fwd = self._build_forward()
+
+        self._step_idx = 0
+        self._arrivals: Dict[int, float] = {}
+        self._token_times: Dict[int, List[float]] = {}
+        self.completions: List[Completion] = []
+        reg = get_registry() if enabled() else None
+        self._m = None
+        if reg is not None:
+            self._m = {
+                "steps": reg.counter("serving_steps",
+                                     "engine steps run"),
+                "gen": reg.counter("serving_generated_tokens",
+                                   "tokens sampled and emitted"),
+                "prefill": reg.counter("serving_prefill_tokens",
+                                       "prompt tokens written to cache"),
+                "admitted": reg.counter("serving_admitted",
+                                        "requests admitted into slots"),
+                "retired": reg.counter("serving_retired",
+                                       "sequences retired"),
+                "active": reg.gauge("serving_active_seqs",
+                                    "occupied slots"),
+                "queue": reg.gauge("serving_queue_depth",
+                                   "waiting requests (rank 0)"),
+                "pages": reg.gauge("serving_free_pages",
+                                   "free KV pages"),
+                "step_s": reg.histogram("serving_step_seconds",
+                                        "wall time per engine step"),
+            }
+        self._fr = _flight.get_flight_recorder()
+
+    # -- forward -------------------------------------------------------------
+    def _build_forward(self):
+        model = self._model_tp
+        n_layers = model.n_layers
+
+        def forward(params, ck, cv, page_table, tokens, pos0, n_new):
+            new_k: list = [None] * n_layers
+            new_v: list = [None] * n_layers
+
+            def attend(layer, q, k, v):
+                lk = _kv.write_kv(ck[layer], page_table, pos0, n_new, k)
+                lv = _kv.write_kv(cv[layer], page_table, pos0, n_new, v)
+                new_k[layer], new_v[layer] = lk, lv
+                return _kv.paged_attention(q, lk, lv, page_table, pos0)
+
+            logits = model.apply(params, tokens, pos_offset=pos0,
+                                 attend=attend)
+            last = jnp.clip(n_new - 1, 0, tokens.shape[1] - 1)
+            last_logits = jnp.take_along_axis(
+                logits, last[:, None, None], axis=1)[:, 0]  # [B, vocab]
+            sampled = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return sampled, last_logits, jnp.stack(new_k), jnp.stack(new_v)
+
+        if self._mesh is None:
+            return jax.jit(forward)
+
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu import utils as _utils
+
+        def body(params_st, ck_st, cv_st, page_table, tokens, pos0, n_new):
+            params = jax.tree.map(lambda x: x[0], params_st)
+            sampled, last_logits, nk, nv = forward(
+                params, ck_st[0], cv_st[0], page_table, tokens, pos0,
+                n_new)
+            return sampled, last_logits, nk[None], nv[None]
+
+        return jax.jit(_utils.shard_map(
+            body, mesh=self._mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P(), P(), P(), P()),
+            out_specs=(P(), P(), P("tp"), P("tp")), check_vma=False))
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               arrival: Optional[float] = None) -> int:
+        """Queue a request (rank 0).  ``arrival`` defaults to now."""
+        arrival = time.perf_counter() if arrival is None else arrival
+        rid = self.scheduler.submit(list(map(int, prompt)),
+                                    max_new_tokens, arrival)
+        self._arrivals[rid] = arrival
+        return rid
+
+    def idle(self) -> bool:
+        return self.scheduler.idle()
+
+    # -- the step loop -------------------------------------------------------
+    def step(self) -> StepResult:
+        t0 = time.perf_counter()
+        sched = self.scheduler
+        if self.plane.size > 1:
+            plan = sched.build_plan() if self.plane.rank == 0 else None
+            plan = self.plane.bcast_obj(plan, root=0)
+        else:
+            plan = sched.build_plan()
+        tok = None
+        if self._fr is not None:
+            tok = self._fr.span_begin(
+                "serving", "serving_step", step=self._step_idx,
+                admitted=len(plan["admit"]), retired=len(plan["retire"]))
+        retired = sched.apply_plan(plan)
+        completed = [self._finish(slot) for _, slot in retired]
+
+        batch = sched.step_batch()
+        n_new = batch["n_new"]
+        ran = bool(n_new.sum())
+        emitted: list = []
+        last_logits = None
+        if ran:
+            sampled_d, logits_d, self._ck, self._cv = self._fwd(
+                self._params, self._ck, self._cv,
+                jnp.asarray(batch["page_table"]),
+                jnp.asarray(batch["tokens"]), jnp.asarray(batch["pos0"]),
+                jnp.asarray(n_new))
+            sampled = np.asarray(sampled_d)   # device sync point
+            if self.cfg.keep_logits:
+                last_logits = np.asarray(logits_d)
+            emitted = sched.note_sampled(n_new, sampled)
+            now = time.perf_counter()
+            for rid, _tok, _n in emitted:
+                self._token_times.setdefault(rid, []).append(now)
+
+        if self._m is not None:
+            decode = sum(1 for i in range(len(n_new))
+                         if n_new[i] == 1 and emitted)
+            del decode  # derived lanes live in obs_report
+            self._m["steps"].inc()
+            self._m["gen"].inc(len(emitted))
+            self._m["prefill"].inc(int(n_new.sum()) - len(emitted))
+            self._m["admitted"].inc(len(plan["admit"]))
+            self._m["retired"].inc(len(plan["retire"]))
+            self._m["active"].set(sched.active_count)
+            self._m["queue"].set(sched.queue_depth)
+            self._m["pages"].set(sched.allocator.num_free)
+            self._m["step_s"].observe(time.perf_counter() - t0)
+        if self._fr is not None:
+            self._fr.span_end(tok, emitted=len(emitted),
+                              ran_forward=ran)
+        res = StepResult(step=self._step_idx, plan=plan, emitted=emitted,
+                         completed=completed, ran_forward=ran,
+                         last_logits=last_logits, n_new=n_new)
+        self._step_idx += 1
+        return res
+
+    def _finish(self, slot) -> Completion:
+        comp = Completion(
+            rid=slot.rid, prompt_len=len(slot.prompt),
+            tokens=list(slot.generated),
+            arrival=self._arrivals.get(slot.rid, 0.0),
+            token_times=self._token_times.pop(slot.rid, []))
+        self.completions.append(comp)
+        return comp
+
+    def run_until_idle(self, max_steps: int = 10_000) -> List[Completion]:
+        """Step until every submitted request has retired (single
+        controller convenience; multi-controller worlds drive ``step()``
+        in lockstep themselves)."""
+        start = len(self.completions)
+        for _ in range(max_steps):
+            if self.idle():
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"engine still busy after {max_steps} steps "
+                f"(active={self.scheduler.active_count}, "
+                f"queued={self.scheduler.queue_depth})")
+        return self.completions[start:]
+
+
+__all__ = ["Completion", "InferenceEngine", "ServingConfig", "StepResult"]

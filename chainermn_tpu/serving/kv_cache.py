@@ -1,0 +1,162 @@
+"""Paged KV cache — fixed-size pages, per-sequence page tables.
+
+The physical cache for one layer is ``[num_pages + 1, page_size, Hkv,
+Dh]``: ``num_pages`` allocatable pages plus one TRASH page (the last
+physical index) that absorbs the writes of padded/inactive batch rows so
+the fused prefill+decode step can run a fixed ``[B, S]`` shape every
+step without conditionals.
+
+**Layout invariant** (everything below leans on it): a sequence's page
+table row maps logical page ``j`` to the physical page holding its
+global token positions ``j*page_size .. (j+1)*page_size - 1``, filled
+left to right with no holes.  Gathering a row's pages back-to-back
+therefore reconstructs the sequence contiguously — gathered index ``i``
+IS global position ``i`` — so causal attention over the gathered cache
+needs no extra validity mask: positions beyond a sequence's current
+length are strictly greater than its query positions and the
+global-position causal mask of
+:func:`~chainermn_tpu.ops.flash_attention.flash_attention` (per-sequence
+``q_offset``) drops them.  Unwritten tails of partial pages and
+never-allocated table entries sit in that masked region by construction.
+
+Page accounting (alloc on admission, free on retirement/eviction) is
+host-side and deterministic — :class:`PageAllocator` always hands out
+the lowest-numbered free pages — so every controller of a multi-process
+serving world reaches the identical physical layout from the identical
+admission plan (the lockstep contract of
+:class:`~chainermn_tpu.serving.engine.InferenceEngine`).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+
+class KvCache(NamedTuple):
+    """Per-layer stacked physical pages: ``k``/``v`` are
+    ``[n_layers, num_pages + 1, page_size, Hkv, Dh]`` (last physical
+    page = trash)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def num_pages(self) -> int:
+        """Allocatable pages (the trash page is not counted)."""
+        return int(self.k.shape[1]) - 1
+
+    @property
+    def page_size(self) -> int:
+        return int(self.k.shape[2])
+
+
+def init_kv_cache(n_layers: int, num_pages: int, page_size: int,
+                  n_kv_heads: int, head_dim: int,
+                  dtype=jnp.float32) -> KvCache:
+    """Zero-initialized cache with ``num_pages`` allocatable pages plus
+    the trash page."""
+    shape = (n_layers, num_pages + 1, page_size, n_kv_heads, head_dim)
+    return KvCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_kv(cache_layer, page_table, pos0, n_new, new):
+    """Scatter one step's K or V into a layer's pages (functional).
+
+    ``cache_layer`` [P+1, page, H, D]; ``page_table`` [B, max_pages]
+    int32; ``pos0`` [B] = each sequence's length BEFORE this step (its
+    first new token's global position); ``n_new`` [B] = valid new tokens
+    this step (0 for idle slots); ``new`` [B, S, H, D].  Row ``b``'s
+    token ``t`` lands at global position ``pos0[b] + t`` — its page and
+    in-page offset follow from the layout invariant; padded tokens
+    (``t >= n_new[b]``) land in the trash page.
+    """
+    n_phys, page_size, h, d = cache_layer.shape
+    trash = n_phys - 1
+    b, s = new.shape[:2]
+    t = jnp.arange(s)[None, :]
+    pos = pos0[:, None] + t                                  # [B, S]
+    logical = jnp.clip(pos // page_size, 0, page_table.shape[1] - 1)
+    phys = jnp.take_along_axis(page_table, logical, axis=1)  # [B, S]
+    valid = t < n_new[:, None]
+    phys = jnp.where(valid, phys, trash)
+    flat_idx = phys * page_size + pos % page_size
+    flat = cache_layer.reshape(-1, h, d)
+    flat = flat.at[flat_idx.reshape(-1)].set(
+        new.astype(cache_layer.dtype).reshape(-1, h, d))
+    return flat.reshape(cache_layer.shape)
+
+
+def gather_kv(cache_layer, page_table):
+    """Gather every sequence's pages back into contiguous
+    ``[B, max_pages * page_size, H, D]`` — index ``i`` is global
+    position ``i`` (layout invariant)."""
+    n_phys, page_size, h, d = cache_layer.shape
+    b, m = page_table.shape
+    idx = (page_table[:, :, None] * page_size +
+           jnp.arange(page_size)[None, None, :]).reshape(b, m * page_size)
+    return cache_layer.reshape(-1, h, d)[idx]
+
+
+def paged_attention(q, cache_k_layer, cache_v_layer, page_table, pos0,
+                    sm_scale: Optional[float] = None):
+    """Cache-offset-aware causal attention over the paged cache.
+
+    ``q`` [B, S, H, D] are this step's queries at global positions
+    ``pos0[b] + t`` (write the step's K/V first so queries see
+    themselves).  Layered directly on the fused kernel: the gathered
+    cache is position-aligned, so the per-sequence ``q_offset`` vector
+    is the whole masking story — garbage beyond each sequence's length
+    is causal-masked, real history is visible.  GQA passes through
+    (``Hkv`` divides ``H``).
+    """
+    kc = gather_kv(cache_k_layer, page_table)
+    vc = gather_kv(cache_v_layer, page_table)
+    return flash_attention(q, kc, vc, causal=True, sm_scale=sm_scale,
+                           q_offset=pos0)
+
+
+class PageAllocator:
+    """Deterministic host-side free-page list.
+
+    Always allocates the lowest-numbered free pages, so identical
+    alloc/free call sequences on different controllers produce identical
+    physical layouts (the lockstep-admission contract).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages))  # sorted ascending
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take the ``n`` lowest free pages, or None (nothing taken) if
+        fewer than ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        taken, self._free = self._free[:n], self._free[n:]
+        return taken
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 0 <= p < self.num_pages:
+                raise ValueError(f"freeing out-of-range page {p}")
+            i = bisect.bisect_left(self._free, p)
+            if i < len(self._free) and self._free[i] == p:
+                raise ValueError(f"double free of page {p}")
+            self._free.insert(i, p)
+
+
+__all__ = ["KvCache", "PageAllocator", "gather_kv", "init_kv_cache",
+           "paged_attention", "write_kv"]

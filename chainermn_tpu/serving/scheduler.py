@@ -1,0 +1,237 @@
+"""Admission scheduling for continuous batching.
+
+The scheduler is split into a pure DECISION step and a deterministic
+APPLY step so a multi-controller serving world can run in lockstep over
+the DCN control plane: rank 0 calls :meth:`AdmissionScheduler.build_plan`
+(no mutation), broadcasts the resulting plain-dict plan with
+``bcast_obj``, and then EVERY rank — rank 0 included — applies the same
+plan with :meth:`AdmissionScheduler.apply_plan`.  Because the plan
+carries the admitted prompts and the page allocator is deterministic
+(:class:`~chainermn_tpu.serving.kv_cache.PageAllocator` hands out the
+lowest free pages), all ranks evolve identical slot states, page tables,
+and — greedy sampling being deterministic on replicated logits —
+identical generated tokens.  Only rank 0 holds the waiting queue.
+
+Two admission policies:
+
+* ``"continuous"`` — every step, waiting requests are packed into any
+  free slot whose page reservation fits (vLLM-style continuous
+  batching; finished sequences retire and their slot refills next
+  step).
+* ``"static"`` — requests are admitted only when ALL slots are empty:
+  the classic static batch, kept as the benchmark baseline
+  (``benchmarks/bench_serving.py``).
+
+Pages are reserved on admission for the worst case
+(``ceil((prompt + max_new) / page_size)``) and freed on retirement —
+admission control IS the eviction policy, so a running sequence can
+never hit an out-of-pages condition mid-flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from chainermn_tpu.serving.kv_cache import PageAllocator
+
+_POLICIES = ("continuous", "static")
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request (rank 0 / client side)."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: float = 0.0  # host-side submit time (never traced)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Replicated per-slot decode state (identical on every rank)."""
+
+    rid: int
+    prompt: List[int]
+    max_new: int
+    pages: List[int]
+    seq_len: int = 0                 # tokens whose KV sit in the cache
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+
+
+class AdmissionScheduler:
+    def __init__(self, *, max_seqs: int, page_size: int, num_pages: int,
+                 max_pages_per_seq: int, chunk_tokens: int,
+                 eos_id: Optional[int] = None,
+                 policy: str = "continuous"):
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, "
+                             f"got {policy!r}")
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages_per_seq = max_pages_per_seq
+        self.chunk_tokens = chunk_tokens
+        self.eos_id = eos_id
+        self.policy = policy
+        self.allocator = PageAllocator(num_pages)
+        self.slots: List[Optional[_Slot]] = [None] * max_seqs
+        self.waiting: Deque[Request] = deque()   # rank 0 only
+        # trash page = physical index num_pages (kv_cache layout);
+        # unassigned table entries point there
+        self.page_table = np.full((max_seqs, max_pages_per_seq),
+                                  num_pages, np.int32)
+        self._next_rid = 0
+
+    # -- client side (rank 0) ------------------------------------------------
+    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+        total = prompt_len + max_new
+        return -(-total // self.page_size)  # ceil
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               arrival: float = 0.0) -> int:
+        """Queue a request (rank 0 only); returns its request id."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        need = self.pages_needed(len(prompt), max_new_tokens)
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"request needs {need} pages (prompt {len(prompt)} + "
+                f"max_new {max_new_tokens} at page_size "
+                f"{self.page_size}) but the page table holds "
+                f"{self.max_pages_per_seq} per sequence — raise "
+                f"max_pages_per_seq or shorten the request")
+        rid = self._next_rid
+        self._next_rid += 1
+        self.waiting.append(Request(rid, list(map(int, prompt)),
+                                    int(max_new_tokens), arrival))
+        return rid
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def idle(self) -> bool:
+        return self.active_count == 0 and not self.waiting
+
+    # -- lockstep plan: decide (rank 0), broadcast, apply (all ranks) --------
+    def build_plan(self) -> dict:
+        """Pure decision: which finished slots retire this step and which
+        waiting requests are admitted into which slots.  Mutates nothing —
+        the same plan is applied by every rank via :meth:`apply_plan`."""
+        retire = [[i, s.rid] for i, s in enumerate(self.slots)
+                  if s is not None and s.finished]
+        retiring = {i for i, _ in retire}
+        free_slots = [i for i, s in enumerate(self.slots)
+                      if s is None or i in retiring]
+        free_pages = self.allocator.num_free + sum(
+            len(self.slots[i].pages) for i in retiring)
+        admit = []
+        if self.policy == "static" and len(free_slots) < self.max_seqs:
+            free_slots = []  # static batch: wait for the whole batch
+        for req in self.waiting:
+            if not free_slots:
+                break
+            need = self.pages_needed(len(req.prompt), req.max_new_tokens)
+            if need > free_pages:
+                break  # FIFO head-of-line: keep admission order stable
+            admit.append([free_slots.pop(0), req.rid, list(req.prompt),
+                          req.max_new_tokens])
+            free_pages -= need
+        return {"retire": retire, "admit": admit}
+
+    def apply_plan(self, plan: dict) -> list:
+        """Apply a (possibly remote) plan deterministically.  Returns the
+        retired ``(slot_idx, _Slot)`` pairs (the engine turns them into
+        completions)."""
+        retired = []
+        for slot_idx, rid in plan["retire"]:
+            slot = self.slots[slot_idx]
+            if slot is None or slot.rid != rid:
+                raise RuntimeError(
+                    f"lockstep desync: plan retires rid {rid} from slot "
+                    f"{slot_idx} but this rank holds "
+                    f"{None if slot is None else slot.rid}")
+            self.allocator.free(slot.pages)
+            self.page_table[slot_idx, :] = self.num_pages
+            self.slots[slot_idx] = None
+            retired.append((slot_idx, slot))
+        for slot_idx, rid, prompt, max_new in plan["admit"]:
+            if self.slots[slot_idx] is not None:
+                raise RuntimeError(
+                    f"lockstep desync: admitting rid {rid} into occupied "
+                    f"slot {slot_idx}")
+            need = self.pages_needed(len(prompt), max_new)
+            pages = self.allocator.alloc(need)
+            if pages is None:
+                raise RuntimeError(
+                    f"lockstep desync: no pages for admitted rid {rid} "
+                    f"(need {need}, free {self.allocator.num_free})")
+            self.slots[slot_idx] = _Slot(rid=rid, prompt=list(prompt),
+                                         max_new=max_new, pages=pages)
+            self.page_table[slot_idx, :] = self.num_pages
+            self.page_table[slot_idx, :len(pages)] = pages
+            if self.waiting and self.waiting[0].rid == rid:
+                self.waiting.popleft()  # rank 0 drains its queue
+        return retired
+
+    # -- per-step batch construction ----------------------------------------
+    def step_batch(self) -> Dict[str, np.ndarray]:
+        """Fixed-shape [B, S] batch for the fused prefill+decode forward:
+        prefilling slots contribute their next prompt chunk (up to
+        ``chunk_tokens``), decoding slots their last sampled token, idle
+        or finished slots nothing (``n_new == 0``, writes go to the trash
+        page)."""
+        b, s = self.max_seqs, self.chunk_tokens
+        tokens = np.zeros((b, s), np.int32)
+        pos0 = np.zeros((b,), np.int32)
+        n_new = np.zeros((b,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.finished:
+                continue
+            pos0[i] = slot.seq_len
+            if slot.seq_len < len(slot.prompt):          # prefill chunk
+                chunk = slot.prompt[slot.seq_len:slot.seq_len + s]
+                tokens[i, :len(chunk)] = chunk
+                n_new[i] = len(chunk)
+            else:                                        # decode: 1 token
+                tokens[i, 0] = slot.generated[-1]
+                n_new[i] = 1
+        return {"tokens": tokens, "pos0": pos0, "n_new": n_new,
+                "page_table": self.page_table.copy()}
+
+    def note_sampled(self, n_new: np.ndarray, sampled: np.ndarray) -> list:
+        """Advance slot state after the forward.  ``sampled[i]`` is the
+        greedy token at slot ``i``'s last valid position.  Returns the
+        emitted tokens ``[(rid, token, n_generated)]`` — a sequence emits
+        only once its whole prompt is in the cache (the step that
+        consumed the final prompt chunk produces its first token)."""
+        emitted = []
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.finished or n_new[i] == 0:
+                continue
+            slot.seq_len += int(n_new[i])
+            if slot.seq_len < len(slot.prompt):
+                continue  # still prefilling
+            tok = int(sampled[i])
+            slot.generated.append(tok)
+            emitted.append((slot.rid, tok, len(slot.generated)))
+            if (len(slot.generated) >= slot.max_new
+                    or (self.eos_id is not None and tok == self.eos_id)):
+                slot.finished = True
+        return emitted
+
+
+__all__ = ["AdmissionScheduler", "Request"]

@@ -1,0 +1,255 @@
+"""Inference weight loading: consolidate, quantize, shard, broadcast.
+
+The path from a training checkpoint to serving params:
+
+1. **Consolidate** — an FSDP-sharded train state restores only on the
+   training world (shard layouts are bound to the world size);
+   :func:`chainermn_tpu.extensions.checkpoint.consolidate_fsdp_checkpoint`
+   turns its :class:`~chainermn_tpu.parallel.fsdp.FsdpState` nodes into
+   full replicated parameter pytrees (no collective —
+   ``fsdp_full_params``).  :func:`load_inference_params` wraps resume +
+   consolidate + dtype cast into one call.
+2. **Quantize** (optional) — :func:`quantize_inference_params` stores
+   every matrix-shaped leaf as per-channel int8 codes + f32 scales
+   (:func:`chainermn_tpu.compression.quantize.quantize_per_channel_int8`),
+   ~4x fewer bytes on the broadcast wire and in artifacts;
+   :func:`dequantize_inference_params` materializes f32 for the engine.
+3. **Broadcast** — one controller holds the consolidated params;
+   :func:`broadcast_inference_params` ships them to every device of a
+   mesh communicator as a planner ``multicast`` plan
+   (:func:`weights_multicast_plan`) run through the leaf-mode stage
+   chain — the same compiled lowering the gradient planner uses, WITHOUT
+   ``execute_plan``'s mean semantics (a broadcast divided by world size
+   would scale the weights).
+4. **TP-shard** — :func:`shard_params_tp` Megatron-slices the
+   transformer blocks for the engine's ``tp_size > 1`` path: qkv/up
+   kernels column-sliced per local head group, proj/down kernels
+   row-sliced with their biases pre-divided by ``tp_size`` (flax's Dense
+   adds the bias BEFORE the engine's row-parallel psum, so each shard
+   must contribute ``bias / tp``); everything else replicated.  Leaves
+   come back stacked ``[tp, ...]`` ready for ``shard_map`` over the
+   ``"tp"`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+_QUANT_CODES = "int8_codes"
+_QUANT_SCALE = "int8_scale"
+
+
+# -- checkpoint -> consolidated params ---------------------------------------
+
+def load_inference_params(source, metas=None, *, checkpointer=None,
+                          dtype=None, int8_weights: bool = False):
+    """Produce a consolidated inference parameter tree.
+
+    ``source`` is either a live state tree (params, or a tree holding
+    FSDP-sharded sub-states) or — when ``checkpointer`` is given — the
+    resume TEMPLATE whose latest consistent generation is restored
+    first.  Any :class:`~chainermn_tpu.parallel.fsdp.FsdpState` in the
+    result is consolidated via ``consolidate_fsdp_checkpoint`` (pass the
+    matching ``metas``).  ``dtype`` casts floating leaves (e.g.
+    ``jnp.bfloat16``); ``int8_weights=True`` round-trips matrix leaves
+    through the per-channel int8 codec — the artifact/wire precision —
+    before returning f32 (see :func:`quantize_inference_params` to keep
+    the codes themselves).
+    """
+    from chainermn_tpu.extensions.checkpoint import (
+        consolidate_fsdp_checkpoint)
+    from chainermn_tpu.parallel.fsdp import iter_fsdp_states
+
+    state = source
+    if checkpointer is not None:
+        state, gen = checkpointer.resume(source)
+        if gen is None:
+            raise FileNotFoundError(
+                f"no consistent checkpoint generation found under "
+                f"{getattr(checkpointer, 'path', '?')!r} for "
+                f"{getattr(checkpointer, 'name', '?')!r} — train and "
+                f"save first, or point the loader at the right path")
+    if any(True for _ in iter_fsdp_states(state)):
+        if metas is None:
+            raise ValueError(
+                "source holds FSDP-sharded state but no FsdpMeta was "
+                "given — pass the meta(s) from fsdp_init (the sharded "
+                "layout cannot be consolidated without it)")
+        state = consolidate_fsdp_checkpoint(state, metas)
+    if int8_weights:
+        state = dequantize_inference_params(
+            quantize_inference_params(state))
+    if dtype is not None:
+        state = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            state)
+    return state
+
+
+# -- optional per-channel int8 ------------------------------------------------
+
+def _is_matrix(x) -> bool:
+    return hasattr(x, "ndim") and x.ndim >= 2 and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.floating)
+
+
+def quantize_inference_params(params):
+    """Per-channel int8 form of a parameter tree: every floating leaf
+    with ``ndim >= 2`` becomes ``{"int8_codes", "int8_scale"}`` (channel
+    = last axis, the output channel of flax Dense/Embed kernels);
+    vectors and scalars stay f32 (biases/norms are tiny and
+    precision-critical).  ~4x fewer bytes for artifacts and the
+    multicast wire."""
+    from chainermn_tpu.compression.quantize import (
+        quantize_per_channel_int8)
+
+    def leaf(x):
+        if not _is_matrix(x):
+            return x
+        codes, scale = quantize_per_channel_int8(x, channel_axis=-1)
+        return {_QUANT_CODES: codes, _QUANT_SCALE: scale}
+
+    return jax.tree.map(leaf, params)
+
+
+def dequantize_inference_params(qparams):
+    """Inverse of :func:`quantize_inference_params`."""
+    from chainermn_tpu.compression.quantize import dequantize_int8
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node) == {_QUANT_CODES, _QUANT_SCALE}:
+                return dequantize_int8(node[_QUANT_CODES],
+                                       node[_QUANT_SCALE])
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            t = type(node)
+            return t(walk(v) for v in node)
+        return node
+
+    return walk(qparams)
+
+
+# -- planner multicast broadcast ----------------------------------------------
+
+def weights_multicast_plan(root: int = 0, name: str = "serving_weights"):
+    """The broadcast as a serializable planner plan: one leaf-packed
+    ``multicast`` stage over the communicator's full scope."""
+    from chainermn_tpu.planner.ir import Plan, Stage
+
+    return Plan(name=name, packing="leaf",
+                stages=(Stage(op="multicast", scope="all", root=root),))
+
+
+def broadcast_inference_params(comm, params, root: int = 0):
+    """Ship ``root``'s consolidated params to every device of ``comm``
+    via the multicast plan's leaf-mode stage chain (NOT ``execute_plan``,
+    whose gradient-mean division would scale the weights by 1/size).
+    ``params`` is root's tree; returns the replicated tree (identical on
+    every rank).  Quantized trees from
+    :func:`quantize_inference_params` pass through — int8 codes ride the
+    wire at 1/4 the bytes.
+    """
+    from chainermn_tpu.planner.compiler import _run_stages_leaf
+
+    plan = weights_multicast_plan(root=root)
+    topology = comm.plan_topology()
+    size = comm.size
+
+    def place(x):
+        x = jnp.asarray(x)
+        stacked = jnp.zeros((size,) + x.shape, x.dtype)
+        return stacked.at[root].set(x)
+
+    def body(p):
+        # int8 leaves multicast exactly: the masked-psum lowering sums
+        # one non-zero contribution, which int arithmetic represents
+        return jax.tree.map(
+            lambda leaf: _run_stages_leaf(plan, topology, leaf), p)
+
+    out = comm.run_spmd(body, jax.tree.map(place, params))
+    return jax.tree.map(lambda x: x[root], out)
+
+
+# -- Megatron tensor-parallel slicing -----------------------------------------
+
+def shard_params_tp(params, tp_size: int, *, n_heads: int,
+                    n_kv_heads: Optional[int] = None):
+    """Slice a :class:`~chainermn_tpu.models.transformer.TransformerLM`
+    parameter tree Megatron-style into ``tp_size`` shards, stacked on a
+    new leading ``[tp]`` axis (feed through ``shard_map`` with in_spec
+    ``P("tp")``; each device squeezes its slice).
+
+    Per block: ``qkv`` column-sliced by head group (q by ``n_heads``,
+    k/v by ``n_kv_heads`` — GQA groups stay with their queries), ``up``
+    column-sliced, ``proj``/``down`` row-sliced with bias pre-divided by
+    ``tp_size`` (Dense adds bias before the block's row-parallel psum).
+    Embeddings, layer norms, and the head are replicated.
+    """
+    try:
+        from flax import traverse_util
+    except ImportError as e:  # pragma: no cover - flax ships with models
+        raise ImportError("shard_params_tp needs flax") from e
+
+    tp = int(tp_size)
+    n_kv = n_kv_heads or n_heads
+    if tp < 1 or n_heads % tp or n_kv % tp:
+        raise ValueError(
+            f"tp_size ({tp}) must be >= 1 and divide n_heads "
+            f"({n_heads}) and n_kv_heads ({n_kv})")
+
+    flat = traverse_util.flatten_dict(params)
+
+    def qkv_slices(w, axis):
+        d = w.shape[axis]
+        hd = d // (n_heads + 2 * n_kv)
+        if hd * (n_heads + 2 * n_kv) != d:
+            raise ValueError(
+                f"qkv dim {d} is not (n_heads + 2*n_kv_heads) * head_dim "
+                f"for n_heads={n_heads}, n_kv_heads={n_kv}")
+        nq, nkv = n_heads * hd, n_kv * hd
+        take = lambda a, b: jax.lax.slice_in_dim(w, a, b, axis=axis)
+        q, k, v = take(0, nq), take(nq, nq + nkv), take(nq + nkv, d)
+        lq, lkv = nq // tp, nkv // tp
+        return [jnp.concatenate(
+            [jax.lax.slice_in_dim(q, i * lq, (i + 1) * lq, axis=axis),
+             jax.lax.slice_in_dim(k, i * lkv, (i + 1) * lkv, axis=axis),
+             jax.lax.slice_in_dim(v, i * lkv, (i + 1) * lkv, axis=axis)],
+            axis=axis) for i in range(tp)]
+
+    def col_slices(w, axis):
+        n = w.shape[axis] // tp
+        if n * tp != w.shape[axis]:
+            raise ValueError(
+                f"dim {w.shape[axis]} not divisible by tp_size {tp}")
+        return [jax.lax.slice_in_dim(w, i * n, (i + 1) * n, axis=axis)
+                for i in range(tp)]
+
+    out = {}
+    for path, w in flat.items():
+        module = path[-2] if len(path) >= 2 else ""
+        leafname = path[-1]
+        w = jnp.asarray(w)
+        if module == "qkv":
+            shards = qkv_slices(w, axis=-1 if leafname == "kernel" else 0)
+        elif module == "up":
+            shards = col_slices(w, axis=-1 if leafname == "kernel" else 0)
+        elif module in ("proj", "down"):
+            if leafname == "kernel":
+                shards = col_slices(w, axis=0)       # row-parallel input
+            else:
+                shards = [w / tp] * tp               # bias before psum
+        else:
+            shards = [w] * tp                        # replicated
+        out[path] = jnp.stack(shards)
+
+    return traverse_util.unflatten_dict(out)
+
+
+__all__ = ["broadcast_inference_params", "dequantize_inference_params",
+           "load_inference_params", "quantize_inference_params",
+           "shard_params_tp", "weights_multicast_plan"]

@@ -445,6 +445,63 @@ class TestCheckpointGuards:
             ckpt.resume(jax.tree.map(jnp.zeros_like, {"fsdp": state_b}))
 
 
+# ---- per-channel int8 weight quantization (serving) -------------------------
+
+class TestPerChannelInt8Weights:
+    """Property tests for the serving weight codec
+    (``quantize_per_channel_int8``): the round-trip error is bounded by
+    half a quantization step PER CHANNEL, which is never worse — and on
+    scale-skewed matrices strictly better — than one per-tensor step."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    @pytest.mark.parametrize("shape", [(16, 8), (7, 33), (4, 4, 12)])
+    def test_roundtrip_error_bounded_by_channel_step(self, seed, shape):
+        from chainermn_tpu.compression.quantize import (
+            dequantize_int8, quantize_per_channel_int8)
+
+        rng = np.random.default_rng(seed)
+        # skew channel scales over 4 orders of magnitude — the regime
+        # per-tensor quantization loses small channels entirely
+        scales = 10.0 ** rng.uniform(-2, 2, size=shape[-1])
+        w = rng.normal(size=shape) * scales
+        codes, scale = quantize_per_channel_int8(jnp.asarray(w))
+        assert codes.dtype == jnp.int8
+        err = np.abs(np.asarray(dequantize_int8(codes, scale)) - w)
+        # |err| <= scale/2 per channel (round-to-nearest on amax/127)
+        bound = np.broadcast_to(np.asarray(scale) / 2 + 1e-12, shape)
+        assert (err <= bound).all(), float((err - bound).max())
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_beats_per_tensor_on_skewed_channels(self, seed):
+        from chainermn_tpu.compression.quantize import (
+            dequantize_int8, quantize_per_channel_int8,
+            quantize_per_tensor_int8)
+
+        rng = np.random.default_rng(100 + seed)
+        scales = 10.0 ** rng.uniform(-3, 1, size=32)
+        w = jnp.asarray(rng.normal(size=(64, 32)) * scales)
+        cc, cs = quantize_per_channel_int8(w)
+        tc, ts = quantize_per_tensor_int8(w)
+        err_c = float(jnp.abs(dequantize_int8(cc, cs) - w).max())
+        err_t = float(jnp.abs(dequantize_int8(tc, ts) - w).max())
+        # per-channel max error also respects the PER-TENSOR bound...
+        assert err_c <= float(ts) / 2 + 1e-12
+        # ...and the mean error is strictly better on skewed channels
+        mean_c = float(jnp.abs(dequantize_int8(cc, cs) - w).mean())
+        mean_t = float(jnp.abs(dequantize_int8(tc, ts) - w).mean())
+        assert mean_c < mean_t
+
+    def test_zero_and_constant_channels(self):
+        from chainermn_tpu.compression.quantize import (
+            dequantize_int8, quantize_per_channel_int8)
+
+        w = jnp.stack([jnp.zeros((8,)), jnp.full((8,), 3.0)], axis=-1)
+        codes, scale = quantize_per_channel_int8(w)
+        out = np.asarray(dequantize_int8(codes, scale))
+        assert (out[:, 0] == 0).all()
+        np.testing.assert_allclose(out[:, 1], 3.0, rtol=1e-6)
+
+
 # ---- observability: compression_* family + report lane ----------------------
 
 class TestObservability:

@@ -178,6 +178,57 @@ def test_causal_offsets_match_unfused():
     np.testing.assert_allclose(np.asarray(got), 0.0)
 
 
+def test_per_sequence_offset_vectors_match_per_row():
+    """q_offset/kv_offset accept [B] vectors (the serving decode path:
+    each sequence sits at its own KV-cache length) — every batch row
+    must get its own global-position causal mask, forward and backward,
+    in both backward implementations."""
+    rng = np.random.RandomState(14)
+    b, t, h, d = 3, 64, 2, 32
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, d), jnp.float32) * 0.3
+    q, k, v = mk(), mk(), mk()
+    qo = jnp.array([0, 5, 128], jnp.int32)
+    ko = jnp.array([0, 3, 128], jnp.int32)
+
+    got = flash_attention(q, k, v, True, q_offset=qo, kv_offset=ko)
+    for i in range(b):
+        want = attention(q[i:i + 1], k[i:i + 1], v[i:i + 1], causal=True,
+                         q_offset=int(qo[i]), k_offset=int(ko[i]))
+        np.testing.assert_allclose(np.asarray(got[i:i + 1]),
+                                   np.asarray(want), rtol=3e-4,
+                                   atol=3e-4, err_msg=f"row {i}")
+
+    def loss_ref(a, bb, c):
+        return sum((attention(a[i:i + 1], bb[i:i + 1], c[i:i + 1],
+                              causal=True, q_offset=int(qo[i]),
+                              k_offset=int(ko[i])) ** 2).sum()
+                   for i in range(b))
+
+    want_g = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for impl in ("pallas", "blockwise"):
+        got_g = jax.grad(
+            lambda a, bb, c: (flash_attention(
+                a, bb, c, True, q_offset=qo, kv_offset=ko,
+                bwd_impl=impl) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got_g, want_g, "qkv"):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"[{impl}] grad wrt {name}")
+
+
+def test_offset_vector_shape_validated():
+    rng = np.random.RandomState(15)
+    mk = lambda: jnp.asarray(rng.randn(2, 64, 2, 32), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    with pytest.raises(ValueError, match="q_offset"):
+        flash_attention(q, k, v, True,
+                        q_offset=jnp.zeros((3,), jnp.int32))
+    with pytest.raises(ValueError, match="kv_offset"):
+        flash_attention(q, k, v, True,
+                        kv_offset=jnp.zeros((5,), jnp.int32))
+
+
 def test_return_lse_value_and_gradient():
     """The lse output equals the dense logsumexp and is differentiable —
     grads through (out, lse) match the pure-XLA computation."""

@@ -1,0 +1,500 @@
+"""Serving subsystem tests: paged KV cache, admission scheduling, the
+continuous-batching engine, weight loading/broadcast, and 2-process
+lockstep admission.
+
+The load-bearing assertions are the parity ones: paged-KV greedy decode
+must match full-context prefill logits STEP BY STEP (the cache, the
+per-sequence offsets, and the fused prefill+decode batch are all in that
+comparison), and the tp=2 engine must reproduce the tp=1 tokens exactly
+(the Megatron slicing + psum path).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving import (AdmissionScheduler, InferenceEngine,
+                                   PageAllocator, ServingConfig,
+                                   broadcast_inference_params,
+                                   dequantize_inference_params,
+                                   gather_kv, init_kv_cache,
+                                   load_inference_params, paged_attention,
+                                   quantize_inference_params,
+                                   shard_params_tp, write_kv)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = TransformerLM(vocab=61, d_model=32, n_layers=2, n_heads=4,
+                          max_len=128, attention_impl="xla", n_kv_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _prompts(sizes=(5, 3, 9), vocab=61, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, vocab, size=n))) for n in sizes]
+
+
+# ---- page allocator ---------------------------------------------------------
+
+class TestPageAllocator:
+    def test_lowest_first_and_exhaustion(self):
+        a = PageAllocator(4)
+        assert a.alloc(2) == [0, 1]
+        assert a.alloc(3) is None          # nothing taken on failure
+        assert a.num_free == 2
+        assert a.alloc(2) == [2, 3]
+
+    def test_free_reuses_lowest(self):
+        a = PageAllocator(4)
+        p = a.alloc(3)
+        a.free([p[1]])
+        assert a.alloc(1) == [p[1]]
+
+    def test_double_free_and_range_checked(self):
+        a = PageAllocator(2)
+        a.alloc(1)
+        a.free([0])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([0])
+        with pytest.raises(ValueError, match="out-of-range"):
+            a.free([2])
+
+    def test_deterministic_across_instances(self):
+        ops = [("a", 2), ("f", [0]), ("a", 1), ("a", 2), ("f", [3]),
+               ("a", 2)]
+        traces = []
+        for _ in range(2):
+            a, trace = PageAllocator(6), []
+            for op, arg in ops:
+                trace.append(a.alloc(arg) if op == "a"
+                             else a.free(arg))
+            traces.append(trace)
+        assert traces[0] == traces[1]
+
+
+# ---- paged cache ------------------------------------------------------------
+
+class TestPagedKv:
+    def test_write_then_gather_is_position_aligned(self):
+        cache = init_kv_cache(1, num_pages=4, page_size=4, n_kv_heads=1,
+                              head_dim=2)
+        table = jnp.array([[2, 0, 4, 4]], jnp.int32)  # trash = 4
+        new = jnp.arange(6 * 2, dtype=jnp.float32).reshape(1, 6, 1, 2)
+        k = write_kv(cache.k[0], table, jnp.array([0]), jnp.array([6]),
+                     new)
+        got = gather_kv(k, table)
+        np.testing.assert_array_equal(np.asarray(got)[0, :6],
+                                      np.asarray(new)[0])
+        # beyond the written length: untouched zeros
+        assert not np.asarray(got)[0, 6:].any()
+
+    def test_idle_rows_write_only_trash(self):
+        cache = init_kv_cache(1, num_pages=2, page_size=2, n_kv_heads=1,
+                              head_dim=1)
+        table = jnp.array([[0, 1]], jnp.int32)
+        junk = jnp.full((1, 2, 1, 1), 7.0)
+        k = write_kv(cache.k[0], table, jnp.array([0]), jnp.array([0]),
+                     junk)
+        assert not np.asarray(k)[:2].any()      # real pages untouched
+        assert np.asarray(k)[2].any()           # junk landed in trash
+
+    def test_second_chunk_lands_after_first(self):
+        cache = init_kv_cache(1, num_pages=4, page_size=4, n_kv_heads=1,
+                              head_dim=1)
+        table = jnp.array([[1, 3, 4, 4]], jnp.int32)
+        c1 = jnp.ones((1, 3, 1, 1))
+        c2 = 2 * jnp.ones((1, 3, 1, 1))
+        k = write_kv(cache.k[0], table, jnp.array([0]), jnp.array([3]), c1)
+        k = write_kv(k, table, jnp.array([3]), jnp.array([3]), c2)
+        got = np.asarray(gather_kv(k, table))[0, :, 0, 0]
+        np.testing.assert_array_equal(got[:6], [1, 1, 1, 2, 2, 2])
+
+    def test_paged_attention_matches_unpaged_flash(self):
+        from chainermn_tpu.ops.flash_attention import flash_attention
+
+        rng = np.random.default_rng(1)
+        b, t, h, d, page = 2, 6, 2, 4, 4
+        k_full = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        v_full = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+        cache = init_kv_cache(1, num_pages=8, page_size=page,
+                              n_kv_heads=h, head_dim=d)
+        table = jnp.array([[0, 2, 8, 8], [5, 1, 8, 8]], jnp.int32)
+        zeros = jnp.zeros((b,), jnp.int32)
+        ck = write_kv(cache.k[0], table, zeros, jnp.full((b,), t), k_full)
+        cv = write_kv(cache.v[0], table, zeros, jnp.full((b,), t), v_full)
+        # decode step: 1 query at position t-1 against the cached t keys
+        got = paged_attention(q, ck, cv, table,
+                              jnp.full((b,), t - 1, jnp.int32))
+        want = flash_attention(q, k_full, v_full, causal=True,
+                               q_offset=t - 1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+# ---- scheduler --------------------------------------------------------------
+
+def _sched(**kw):
+    base = dict(max_seqs=2, page_size=4, num_pages=8,
+                max_pages_per_seq=4, chunk_tokens=4)
+    base.update(kw)
+    return AdmissionScheduler(**base)
+
+
+class TestScheduler:
+    def test_continuous_admits_into_freed_slot(self):
+        s = _sched()
+        s.submit([1, 2], 2)
+        s.submit([3, 4], 2)
+        s.submit([5, 6], 2)                   # waits: both slots busy
+        s.apply_plan(s.build_plan())
+        assert s.active_count == 2 and s.queue_depth == 1
+        s.slots[0].finished = True
+        plan = s.build_plan()
+        assert plan["retire"] and plan["admit"]
+        assert plan["admit"][0][0] == 0       # refills the retired slot
+        s.apply_plan(plan)
+        assert s.active_count == 2 and s.queue_depth == 0
+
+    def test_static_waits_for_whole_batch(self):
+        s = _sched(policy="static")
+        s.submit([1, 2], 2)
+        s.submit([3, 4], 2)
+        s.submit([5, 6], 2)
+        s.apply_plan(s.build_plan())
+        s.slots[0].finished = True
+        plan = s.build_plan()                 # one slot still running
+        assert plan["retire"] and not plan["admit"]
+        s.apply_plan(plan)
+        s.slots[1].finished = True
+        plan = s.build_plan()                 # now ALL slots drain
+        assert plan["admit"]
+
+    def test_fifo_head_of_line_blocking(self):
+        s = _sched(num_pages=3)               # room for one 2-page req
+        s.submit([1] * 5, 3)                  # needs 2 pages
+        s.submit([2], 1)                      # needs 1 — but behind head
+        s.apply_plan(s.build_plan())
+        plan = s.build_plan()
+        assert not plan["admit"]              # head needs 2, only 1 free
+
+    def test_reservation_covers_max_new(self):
+        s = _sched()
+        assert s.pages_needed(5, 6) == 3      # ceil(11 / 4)
+        with pytest.raises(ValueError, match="max_pages_per_seq"):
+            s.submit([1] * 10, 10)            # 5 pages > 4
+
+    def test_lockstep_mirror_stays_identical(self):
+        """A follower scheduler applying only the broadcast plans (and
+        the same sampled tokens) tracks the leader's state exactly."""
+        lead, follow = _sched(), _sched()
+        rng = np.random.default_rng(2)
+        lead.submit([1, 2, 3], 2)
+        lead.submit([4, 5], 2)
+        lead.submit([6], 1)
+        for _ in range(12):
+            plan = lead.build_plan()
+            lead.apply_plan(plan)
+            follow.apply_plan(plan)
+            batch = lead.step_batch()
+            fbatch = follow.step_batch()
+            np.testing.assert_array_equal(batch["page_table"],
+                                          fbatch["page_table"])
+            np.testing.assert_array_equal(batch["tokens"],
+                                          fbatch["tokens"])
+            sampled = rng.integers(1, 50, size=lead.max_seqs)
+            assert lead.note_sampled(batch["n_new"], sampled) == \
+                follow.note_sampled(fbatch["n_new"], sampled)
+        assert lead.idle() and follow.idle()
+
+    def test_apply_detects_desync(self):
+        s = _sched()
+        s.submit([1, 2], 1)
+        s.apply_plan(s.build_plan())
+        with pytest.raises(RuntimeError, match="lockstep desync"):
+            s.apply_plan({"retire": [[0, 999]], "admit": []})
+        with pytest.raises(RuntimeError, match="lockstep desync"):
+            s.apply_plan({"retire": [],
+                          "admit": [[0, 7, [1], 1]]})
+
+
+# ---- engine: decode parity --------------------------------------------------
+
+class TestEngineParity:
+    def test_paged_decode_matches_full_context_logits_per_step(self, tiny):
+        """THE acceptance check: every decode step's logits from the
+        paged-KV fused forward match a full-context prefill of the same
+        prefix, and the greedy tokens agree."""
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=3,
+                            chunk_tokens=4, max_pages_per_seq=8,
+                            keep_logits=True)
+        eng = InferenceEngine(model, params, cfg)
+        prompts = _prompts()
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        ctx = {r: list(p) for r, p in zip(rids, prompts)}
+        checked = 0
+        for _ in range(40):
+            if eng.idle():
+                break
+            res = eng.step()
+            if not res.ran_forward:
+                continue
+            slot_of = {s.rid: i for i, s in
+                       enumerate(eng.scheduler.slots) if s is not None}
+            for rid, tok, _n in res.emitted:
+                ref = model.apply(
+                    params, jnp.asarray([ctx[rid]], jnp.int32))[0, -1]
+                got = res.last_logits[slot_of[rid]]
+                np.testing.assert_allclose(got, np.asarray(ref),
+                                           atol=1e-4, rtol=1e-4)
+                assert tok == int(jnp.argmax(ref))
+                ctx[rid].append(tok)
+                checked += 1
+        assert eng.idle()
+        assert checked == 3 * 5               # every token was verified
+
+    def test_all_pages_freed_after_drain(self, tiny):
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=16, max_seqs=2,
+                            chunk_tokens=4, max_pages_per_seq=4)
+        eng = InferenceEngine(model, params, cfg)
+        for p in _prompts((4, 6, 3, 5)):
+            eng.submit(p, max_new_tokens=3)
+        comps = eng.run_until_idle()
+        assert len(comps) == 4
+        assert eng.scheduler.allocator.num_free == 16
+        assert (eng.scheduler.page_table == 16).all()
+
+    def test_continuous_needs_fewer_steps_than_static(self, tiny):
+        """The continuous-batching win, in steps (the wall-clock version
+        is benchmarks/bench_serving.py): with staggered lengths, refilled
+        slots beat waiting for the whole static batch to drain."""
+        model, params = tiny
+
+        def steps(policy):
+            cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=2,
+                                chunk_tokens=4, max_pages_per_seq=8,
+                                policy=policy)
+            eng = InferenceEngine(model, params, cfg)
+            for n_p, n_new in ((3, 2), (3, 12), (3, 2), (3, 2)):
+                eng.submit(_prompts((n_p,))[0], max_new_tokens=n_new)
+            n = 0
+            while not eng.idle():
+                eng.step()
+                n += 1
+            return n
+
+        assert steps("continuous") < steps("static")
+
+    def test_tp2_matches_tp1_tokens_exactly(self, tiny):
+        model, params = tiny
+        prompts = _prompts()
+
+        def run(tp):
+            cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=3,
+                                chunk_tokens=4, max_pages_per_seq=8,
+                                tp_size=tp)
+            eng = InferenceEngine(model, params, cfg)
+            for p in prompts:
+                eng.submit(p, max_new_tokens=6)
+            return {c.rid: c.tokens for c in eng.run_until_idle()}
+
+        assert run(1) == run(2)
+
+    def test_chunked_prefill_spans_multiple_steps(self, tiny):
+        """A prompt longer than chunk_tokens prefills across steps and
+        still matches full-context greedy decode."""
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=1,
+                            chunk_tokens=4, max_pages_per_seq=8)
+        eng = InferenceEngine(model, params, cfg)
+        prompt = _prompts((11,))[0]
+        eng.submit(prompt, max_new_tokens=4)
+        comps = eng.run_until_idle()
+        seq = list(prompt)
+        for _ in range(4):
+            logits = model.apply(params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        assert comps[0].tokens == seq[len(prompt):]
+
+
+# ---- weights ----------------------------------------------------------------
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("flat")
+
+
+class TestWeights:
+    def test_checkpoint_consolidation_roundtrip(self, tiny, comm,
+                                                tmp_path):
+        import optax
+
+        from chainermn_tpu.extensions import (
+            create_multi_node_checkpointer)
+        from chainermn_tpu.parallel.fsdp import fsdp_init
+
+        model, params = tiny
+        state, meta = fsdp_init(comm, params, optax.sgd(0.1))
+        ck = create_multi_node_checkpointer(comm, str(tmp_path), "snap")
+        ck.save({"fsdp": state}, 7)
+        loaded = load_inference_params({"fsdp": state}, meta,
+                                       checkpointer=ck)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                    np.asarray(b)),
+            params, loaded["fsdp"])
+
+    def test_consolidation_requires_meta(self, tiny, comm):
+        import optax
+
+        from chainermn_tpu.parallel.fsdp import fsdp_init
+
+        model, params = tiny
+        state, _ = fsdp_init(comm, params, optax.sgd(0.1))
+        with pytest.raises(ValueError, match="FsdpMeta"):
+            load_inference_params({"fsdp": state})
+
+    def test_world_size_mismatch_names_serving_loader(self, tiny, comm,
+                                                      tmp_path):
+        """The checkpoint guard must point a mismatched-world resume at
+        the consolidation path (the satellite contract)."""
+        import optax
+
+        from chainermn_tpu.extensions import (
+            create_multi_node_checkpointer)
+        from chainermn_tpu.parallel.fsdp import fsdp_init
+
+        model, params = tiny
+        state, meta = fsdp_init(comm, params, optax.sgd(0.1))
+        ck = create_multi_node_checkpointer(comm, str(tmp_path), "snap")
+        ck.save({"fsdp": state}, 1)
+        arrays = np.load(ck._file(1))
+        from jax.sharding import Mesh
+        small = chainermn_tpu.create_communicator(
+            "flat", mesh=Mesh(np.array(jax.devices()[:2]), ("data",)))
+        state2, _ = fsdp_init(small, params, optax.sgd(0.1))
+        ck2 = create_multi_node_checkpointer(small, str(tmp_path), "snap")
+        with pytest.raises(ValueError,
+                           match="load_inference_params"):
+            ck2._validate_restore(
+                {k: arrays[k] for k in arrays.files},
+                {"fsdp": state2},
+                jax.tree.flatten({"fsdp": state2})[0], 1)
+
+    def test_multicast_broadcast_replicates_exactly(self, tiny, comm):
+        model, params = tiny
+        out = broadcast_inference_params(comm, params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            params, out)
+
+    def test_int8_tree_broadcasts_bit_exactly(self, tiny, comm):
+        model, params = tiny
+        q = quantize_inference_params(params)
+        codes = [l for l in jax.tree.leaves(q)
+                 if l.dtype == jnp.int8]
+        assert codes                          # matrices really went int8
+        out = broadcast_inference_params(comm, q)
+        for a, b in zip(jax.tree.leaves(q), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        deq = dequantize_inference_params(out)
+        assert jax.tree.structure(deq) == jax.tree.structure(params)
+
+    def test_engine_runs_on_int8_roundtripped_weights(self, tiny):
+        model, params = tiny
+        p8 = load_inference_params(params, int8_weights=True)
+        cfg = ServingConfig(page_size=4, num_pages=16, max_seqs=1,
+                            chunk_tokens=4, max_pages_per_seq=4)
+        eng = InferenceEngine(model, p8, cfg)
+        eng.submit(_prompts((5,))[0], max_new_tokens=3)
+        assert len(eng.run_until_idle()[0].tokens) == 3
+
+    def test_shard_params_tp_shapes_and_bias_split(self, tiny):
+        model, params = tiny
+        tp = 2
+        sharded = shard_params_tp(params, tp, n_heads=model.n_heads,
+                                  n_kv_heads=model.n_kv_heads)
+        p0 = params["params"]["block_0"]
+        s0 = sharded["params"]["block_0"]
+        d_model = model.d_model
+        hd = d_model // model.n_heads
+        lq = model.n_heads // tp * hd
+        lkv = model.n_kv_heads // tp * hd
+        assert s0["qkv"]["kernel"].shape == (tp, d_model, lq + 2 * lkv)
+        assert s0["proj"]["kernel"].shape == (tp, lq, d_model)
+        assert s0["up"]["kernel"].shape == (tp, d_model,
+                                            4 * d_model // tp)
+        assert s0["down"]["kernel"].shape == (tp, 4 * d_model // tp,
+                                              d_model)
+        # row-parallel biases pre-divided: shards sum to the original
+        np.testing.assert_allclose(
+            np.asarray(s0["proj"]["bias"]).sum(0),
+            np.asarray(p0["proj"]["bias"]), atol=1e-6)
+        # replicated leaves identical on every shard
+        emb = np.asarray(sharded["params"]["tok_emb"]["embedding"])
+        np.testing.assert_array_equal(emb[0], emb[1])
+
+    def test_shard_params_tp_rejects_bad_tp(self, tiny):
+        model, params = tiny
+        with pytest.raises(ValueError, match="divide"):
+            shard_params_tp(params, 3, n_heads=model.n_heads,
+                            n_kv_heads=model.n_kv_heads)
+
+
+# ---- 2-process lockstep admission ------------------------------------------
+
+_LOCKSTEP_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import jax, jax.numpy as jnp, numpy as np
+from chainermn_tpu.runtime.control_plane import get_control_plane
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving import InferenceEngine, ServingConfig
+
+cp = get_control_plane()
+model = TransformerLM(vocab=37, d_model=16, n_layers=1, n_heads=2,
+                      max_len=64, attention_impl="xla")
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+cfg = ServingConfig(page_size=4, num_pages=16, max_seqs=2,
+                    chunk_tokens=4, max_pages_per_seq=4)
+eng = InferenceEngine(model, params, cfg, plane=cp)
+if cp.rank == 0:
+    rng = np.random.default_rng(3)
+    for n in (5, 3, 6):
+        eng.submit(list(map(int, rng.integers(1, 37, size=n))),
+                   max_new_tokens=4)
+for _ in range(18):   # fixed step count: every rank runs the same loop
+    eng.step()
+tokens = {c.rid: c.tokens for c in eng.completions}
+digest = sorted((r, tuple(t)) for r, t in tokens.items())
+gathered = cp.allgather_obj(digest)
+assert all(g == gathered[0] for g in gathered), gathered
+assert eng.scheduler.allocator.num_free == 16
+print("RESULT " + json.dumps({"rank": cp.rank,
+                              "n_done": len(tokens),
+                              "digest": [[r, list(t)]
+                                         for r, t in digest]}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_lockstep_admission():
+    """Two real controller processes drive the engine in lockstep: only
+    rank 0 holds the queue, plans broadcast over the control plane, and
+    both ranks end with identical completions and fully-freed pages."""
+    from chainermn_tpu.utils.proc_world import spawn_world
+
+    results = spawn_world(_LOCKSTEP_WORKER, n_procs=2, local_devices=1,
+                          timeout=420.0)
+    assert results[0]["n_done"] == 3
+    assert results[0]["digest"] == results[1]["digest"]

@@ -75,6 +75,12 @@ run 0 "$OUT/CMN_LINT_$ROUND.json" \
     bash -c "$PY_TPU tools/cmn_lint.py examples/mnist --json \
         --out '$OUT/CMN_LINT_$ROUND.json' > /dev/null"
 
+run 0 "$OUT/CMN_LINT_SERVING_$ROUND.json" \
+    "cmn-lint the serving decode step (tp=2 Megatron shard_map): the same schedule every lockstep controller must trace from the broadcast plan" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU tools/cmn_lint.py serving/decode --json \
+        --out '$OUT/CMN_LINT_SERVING_$ROUND.json' > /dev/null"
+
 # ---- single-chip steps (run today, re-run on the slice for parity) ----
 
 run 1 "$OUT/TPU_EVIDENCE_$ROUND.json" \
@@ -96,6 +102,18 @@ run 1 "$OUT/VIT_BENCH_$ROUND.json" \
 run 1 "$OUT/LM_BENCH_$ROUND.json" \
     "Transformer-LM bench (554M params, T=8192, flash kernels - the 52% MFU panel)" -- \
     bash -c "$PY_TPU benchmarks/bench_lm.py > '$OUT/LM_BENCH_$ROUND.json'"
+
+# ---- serving: continuous-batching inference engine --------------------
+# Hardware-free (forced CPU mesh) so the serving stack is exercised on
+# every host: the run FAILS unless continuous admission beats the static
+# batch at the same open-loop arrival rate, and the artifact feeds the
+# perf gate's serving throughput floor (docs/serving.md).  On a slice,
+# re-run WITHOUT the env override and with --tp to shard over ICI.
+run 0 "$OUT/SERVING_$ROUND.json" \
+    "continuous-batching serving bench on the 8-way CPU mesh: continuous vs static at the same arrival trace; perf_gate reads continuous.tokens_per_sec" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_serving.py --out '$OUT/SERVING_$ROUND.json' \
+        --metrics '$OUT/SERVING_METRICS_$ROUND.jsonl' > /dev/null"
 
 run 1 "$OUT/PERF_GATE_$ROUND.json" \
     "perf gate: fresh bench artifacts vs checked-in budgets (tools/perf_budgets.json; >3% regression on any tracked throughput FAILS this leg)" -- \

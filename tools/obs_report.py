@@ -211,12 +211,52 @@ def compression_section(records: List[dict]) -> str:
          "bytes saved", "ef residual"], rows)
 
 
+def serving_section(records: List[dict]) -> str:
+    """Serving lane: one row per ``bench_serving`` run (continuous vs
+    static throughput/latency from ``benchmarks/bench_serving.py``) plus
+    the latest ``serving_*`` engine gauges (queue depth, active slots,
+    free KV pages — the admission-control health signals)."""
+    reps = [r for r in records if r.get("kind") == "bench_serving"]
+    parts = []
+    if reps:
+        rows = []
+        for r in reps:
+            ttft = r.get("ttft_s") or {}
+            ptok = r.get("per_token_s") or {}
+            rows.append([
+                str(r.get("policy", "?")),
+                str(r.get("requests", "-")),
+                str(r.get("generated_tokens", "-")),
+                f"{r['tokens_per_sec']:.1f}"
+                if r.get("tokens_per_sec") is not None else "-",
+                _fmt_s(ttft.get("p50")), _fmt_s(ttft.get("p99")),
+                _fmt_s(ptok.get("p50")), _fmt_s(ptok.get("p99")),
+            ])
+        parts.append("serving throughput\n" + _table(
+            ["policy", "reqs", "tokens", "tok/s", "ttft p50",
+             "ttft p99", "tok p50", "tok p99"], rows))
+    latest = _latest_metric_lines(records)
+    gauges = {str(name): r.get("value")
+              for (name, _labels), r in latest.items()
+              if str(name).startswith("serving_")}
+    if gauges:
+        rows = [[k, f"{v:.6g}" if v is not None else "-"]
+                for k, v in sorted(gauges.items())]
+        parts.append("serving engine metrics\n" + _table(
+            ["metric", "value"], rows))
+    if not parts:
+        return ("serving: no bench_serving records or serving_* metrics "
+                "(run benchmarks/bench_serving.py --metrics)")
+    return "\n\n".join(parts)
+
+
 SECTIONS = {
     "collectives": collectives_section,
     "steps": steps_section,
     "straggler": straggler_section,
     "bench": bench_section,
     "compression": compression_section,
+    "serving": serving_section,
 }
 
 
@@ -505,6 +545,9 @@ def main(argv=None) -> int:
     ap.add_argument("--compression", action="store_true",
                     help="print only the gradient-compression lane "
                          "(shorthand for --section compression)")
+    ap.add_argument("--serving", action="store_true",
+                    help="print only the serving lane (shorthand for "
+                         "--section serving)")
     ap.add_argument("--flight", action="store_true",
                     help="merge per-rank flight_<rank>.json hang dumps "
                          "into one timeline")
@@ -553,8 +596,11 @@ def main(argv=None) -> int:
         return 1
     if args.compression and not args.section:
         args.section = "compression"
+    if args.serving and not args.section:
+        args.section = "serving"
     names = [args.section] if args.section else \
-        ["steps", "collectives", "straggler", "bench", "compression"]
+        ["steps", "collectives", "straggler", "bench", "compression",
+         "serving"]
     out = "\n\n".join(SECTIONS[n](records) for n in names)
     if lint_out:
         out += "\n\n" + lint_out
