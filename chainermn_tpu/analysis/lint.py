@@ -242,11 +242,24 @@ class LintContext:
         return self._memo("variants", build)
 
 
-def allreduce_hlo(comm, nelems: int = 1024, dtype=jnp.float32) -> str:
+def allreduce_hlo(comm, nelems: int = 1024, dtype=jnp.float32,
+                  plan=None) -> str:
     """Optimized HLO of the communicator's compiled ``allreduce_grad``
     over one flat ``nelems`` gradient — the census-drift probe (and the
-    program ``bench_allreduce.py --census`` pins as an artifact)."""
+    program ``bench_allreduce.py --census`` pins as an artifact).
+
+    The census probe always compiles the communicator's OWN program (a
+    ``LintContext.plan`` is the spec the program is checked AGAINST,
+    never the program itself — otherwise census-drift could not catch a
+    communicator that ignores its declared plan).  The explicit ``plan``
+    argument here is for callers building their own probe of a specific
+    plan through the same seam (``allreduce_grad(g, compressor=plan)``),
+    e.g. to audit the plan compiler's census including per-hop
+    compression."""
     stacked = jnp.zeros((comm.size, nelems), dtype)
+    if plan is not None:
+        return comm.compiled_hlo(
+            lambda g: comm.allreduce_grad(g, compressor=plan), stacked)
     return comm.compiled_hlo(lambda g: comm.allreduce_grad(g), stacked)
 
 

@@ -185,6 +185,20 @@ def expected_kinds(flavor: str, inter_size: int = 1) -> tuple:
     return plan_census_kinds(plan, topo)
 
 
+#: narrow float wires CPU XLA promotes AROUND the collective on the lint
+#: host (the cast seam stays compiled in; the collective itself runs
+#: wider).  The census dtype lane accepts exactly these widenings —
+#: int8's ``s8`` has no entry, so a quantized hop whose codes never hit
+#: the wire (compression silently off: the collective moves f32) is
+#: always a finding.
+CPU_WIRE_PROMOTIONS = {
+    "bf16": ("f32",),
+    "f16": ("f32",),
+    "f8e4m3fn": ("f16",),
+    "f8e5m2": ("f16",),
+}
+
+
 @rule("census-drift", "error",
       "compiled allreduce_grad decomposition must match the flavor's "
       "plan-derived census",
@@ -193,6 +207,7 @@ def _census_drift(ctx) -> List[Finding]:
     inter = getattr(ctx, "inter_size", 1) or 1
     plan = getattr(ctx, "plan", None)
     flavor = getattr(ctx, "flavor", None)
+    topo = None
     if plan is not None:
         # explicit plan spec (e.g. an autotuned table entry) — derive
         # the census against the communicator's declared topology
@@ -207,18 +222,48 @@ def _census_drift(ctx) -> List[Finding]:
         want = expected_kinds(flavor, inter)
         spec_name = f"flavor {flavor!r}"
     got = ctx.census_schedule.kinds()
-    if got == want:
+    if got != want:
+        return [_finding(
+            f"communicator {spec_name} compiled allreduce_grad to "
+            f"{list(got) or '<no collectives>'} but its decomposition is "
+            f"specified as {list(want)} (inter_size={inter}).  The "
+            "decomposition IS the flavor (docs/performance.md census "
+            "table; CENSUS_r*.json artifact): drift here means a "
+            "different wire cost model and a schedule the other ranks do "
+            "not expect.",
+            expected=list(want), observed=list(got),
+            flavor=flavor or (plan.name if plan is not None else None),
+            inter_size=inter)]
+    if plan is None:
         return []
-    return [_finding(
-        f"communicator {spec_name} compiled allreduce_grad to "
-        f"{list(got) or '<no collectives>'} but its decomposition is "
-        f"specified as {list(want)} (inter_size={inter}).  The "
-        "decomposition IS the flavor (docs/performance.md census table; "
-        "CENSUS_r*.json artifact): drift here means a different wire "
-        "cost model and a schedule the other ranks do not expect.",
-        expected=list(want), observed=list(got),
-        flavor=flavor or (plan.name if plan is not None else None),
-        inter_size=inter)]
+    # Per-hop dtype census (explicit plans only): each compiled
+    # collective must run at its stage's declared wire width — a
+    # compressed stage at its COMPRESSOR's wire.  Same kinds with a
+    # wider hop is the per-hop analogue of census drift: the cost model
+    # (plan_wire_bytes) and the dcn_wire_bytes budget price the hop at
+    # a width the program does not move.
+    from chainermn_tpu.planner.compiler import plan_wire_dtypes
+    want_np = plan_wire_dtypes(plan, topo)
+    want_d = [NP_TO_HLO_DTYPE.get(d, d) for d in want_np]
+    got_d = [op.dtype for op in ctx.census_schedule]
+    out: List[Finding] = []
+    for i, (w, g) in enumerate(zip(want_d, got_d)):
+        if g == w or g in CPU_WIRE_PROMOTIONS.get(w, ()):
+            continue
+        out.append(_finding(
+            f"plan {plan.name!r} hop {i} ({want[i]}) is specified to "
+            f"run its wire in {w} (stage dtype {want_np[i]!r}) but the "
+            f"compiled collective runs in {g} (per-hop dtypes: expected "
+            f"{want_d}, observed {got_d}).  A compressed hop whose "
+            f"codes never hit the wire is compression silently off at "
+            f"full wire cost; a narrower-than-declared hop silently "
+            f"drops numerics — either way plan_wire_bytes and the "
+            f"dcn_wire_bytes budget are pricing a wire the program "
+            f"does not move.",
+            stage=i, expected_dtype=w, observed_dtype=g,
+            expected_dtypes=want_d, observed_dtypes=got_d,
+            plan=plan.name, inter_size=inter))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -375,7 +420,10 @@ def _wire_dtype_mismatch(ctx) -> List[Finding]:
       appear among the compiled reduce-scatter dtypes (one per bucket);
     * a collective :class:`~chainermn_tpu.planner.ir.Plan` — the plan's
       (or a stage's) wire dtype must appear among the compiled
-      collective dtypes.
+      collective dtypes; a stage carrying a per-hop ``compression`` spec
+      expects its COMPRESSOR's wire (int8 -> ``s8``, fp8 ->
+      ``f8e4m3fn``) instead — the DCN hop whose codes never hit the
+      wire is compression silently off at 4x the bytes.
     """
     from chainermn_tpu.compression import resolve_compressor
 
@@ -419,7 +467,14 @@ def _wire_dtype_mismatch(ctx) -> List[Finding]:
             wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
                           f"plan {plan.name!r} wire_dtype {wire!r}"))
         for i, st in enumerate(getattr(plan, "stages", ()) or ()):
-            if getattr(st, "wire_dtype", None):
+            if getattr(st, "compression", None):
+                comp = st.compressor()
+                wire = np.dtype(
+                    str(comp.wire_dtype_for(np.dtype("float32")))).name
+                wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
+                              f"plan {plan.name!r} stage {i} ({st.op}) "
+                              f"compressor {comp.name!r} wire {wire!r}"))
+            elif getattr(st, "wire_dtype", None):
                 wire = np.dtype(st.wire_dtype).name
                 wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
                               f"plan {plan.name!r} stage {i} ({st.op}) "
@@ -479,5 +534,5 @@ def _async_pair(ctx) -> List[Finding]:
     return out
 
 
-__all__ = ["Finding", "NP_TO_HLO_DTYPE", "Rule",
+__all__ = ["CPU_WIRE_PROMOTIONS", "Finding", "NP_TO_HLO_DTYPE", "Rule",
            "SEVERITIES", "all_rules", "expected_kinds", "get_rule", "rule"]

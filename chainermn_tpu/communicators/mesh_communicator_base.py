@@ -448,10 +448,25 @@ class MeshCommunicator(CommunicatorBase):
         * a quantizer (``"int8"`` / ``"fp8"``) — stateful EF compression:
           pass ``state`` (a :class:`~chainermn_tpu.compression.\
 CompressionState` from :meth:`init_compression_state`) and the call
-          returns ``(mean_grads, new_state)`` instead of just grads.
+          returns ``(mean_grads, new_state)`` instead of just grads;
+        * a :class:`~chainermn_tpu.planner.Plan` with per-hop
+          ``Stage.compression`` specs — the DynamiQ path: quantize only
+          the stages that cross the slow hop, with one EF state per
+          compressed stage.  ``state`` is the ``{stage_index:
+          CompressionState}`` dict from :meth:`init_compression_state`
+          (returns ``(mean_grads, new_states)``); with ``state=None``
+          the plan runs from cold in-trace EF (one-shot semantics).
+          Passing a stage-keyed ``state`` dict with ``compressor=None``
+          runs this communicator's own :meth:`plan` per hop.
         """
         from chainermn_tpu.compression import base as _cbase
         from chainermn_tpu.compression import quantize as _cq
+        from chainermn_tpu.planner.ir import Plan as _Plan
+        plan = compressor if isinstance(compressor, _Plan) else None
+        if plan is None and isinstance(state, dict):
+            plan = self.plan()
+        if plan is not None:
+            return self._allreduce_grad_plan(grads, plan, state)
         comp = (_cbase.resolve_compressor(compressor)
                 if compressor is not None else
                 (self.compression if _cq.is_quantizing(self.compression)
@@ -481,15 +496,51 @@ CompressionState` from :meth:`init_compression_state`) and the call
         """Fresh error-feedback state for quantized :meth:`allreduce_grad`
         over ``tree``-shaped gradients (``None`` for stateless codecs).
         Sized for the single packed float32 buffer the compressed path
-        exchanges."""
+        exchanges.
+
+        ``compressor`` may also be a :class:`~chainermn_tpu.planner.Plan`
+        with per-hop ``Stage.compression`` specs, in which case the
+        result is the ``{stage_index: CompressionState}`` dict of
+        per-hop EF states, each sized to the buffer AT that stage
+        (post-reduce-scatter hops see a shard, not the full packed
+        buffer) and tagged with its stage index for the checkpoint
+        sidecar."""
         from chainermn_tpu.compression import base as _cbase
         from chainermn_tpu.compression import quantize as _cq
+        from chainermn_tpu.planner.ir import Plan as _Plan
+        n = sum(int(np.prod(jnp.shape(l))) for l in jax.tree.leaves(tree))
+        if isinstance(compressor, _Plan):
+            from chainermn_tpu.planner.compiler import (
+                init_plan_compression_states)
+            return init_plan_compression_states(
+                compressor, self.plan_topology(), n)
         comp = (_cbase.resolve_compressor(compressor)
                 if compressor is not None else self.compression)
         if not _cq.is_quantizing(comp):
             return None
-        n = sum(int(np.prod(jnp.shape(l))) for l in jax.tree.leaves(tree))
         return comp.init_state(n, self.size)
+
+    def _allreduce_grad_plan(self, grads, plan, states):
+        """Per-hop compressed exchange: execute ``plan`` with one EF
+        state per quantizing stage (``states`` keyed by stage index).
+        Returns ``(mean_grads, new_states)`` when ``states`` is given,
+        plain ``mean_grads`` for the stateless one-shot path."""
+        from chainermn_tpu.planner.compiler import (
+            execute_plan, plan_compressed_hops)
+        if not self.in_spmd_context():
+            raise ValueError(
+                "per-hop compressed allreduce_grad executes a plan and "
+                "must run inside an SPMD region (run_spmd / shard_map); "
+                "eager single-controller mode has no per-stage hops")
+        if states is not None:
+            hops = plan_compressed_hops(plan, self.plan_topology())
+            missing = sorted(set(hops) - set(states))
+            if missing:
+                raise ValueError(
+                    f"per-hop compression states missing for stage(s) "
+                    f"{missing} of plan {plan.name!r}: build them with "
+                    "comm.init_compression_state(grads, plan)")
+        return execute_plan(plan, self, grads, states=states)
 
     def _allreduce_grad_wire(self, grads, wire):
         """NoCompression(wire_dtype): the exact cast-allreduce-cast

@@ -41,50 +41,64 @@ class CompressionState:
     """Per-buffer EF + delayed-scale state (see module docstring).
 
     Children (arrays): ``ef``, ``scale`` (base-2 exponents), ``step``.
-    Static aux: ``spec`` (the compressor's canonical JSON identity) and
-    ``ef_version`` — both ride the treedef, so two states with different
-    compressor configs are *structurally* different pytrees.
+    Static aux: ``spec`` (the compressor's canonical JSON identity),
+    ``ef_version``, and ``hop`` (the plan stage index for per-hop
+    states, ``None`` for whole-collective states) — all ride the
+    treedef, so two states with different compressor configs *or*
+    different hop assignments are *structurally* different pytrees.
     """
 
     def __init__(self, ef, scale, step, spec: str = "",
-                 ef_version: int = EF_VERSION):
+                 ef_version: int = EF_VERSION,
+                 hop: Optional[int] = None):
         self.ef = ef
         self.scale = scale
         self.step = step
         self.spec = spec
         self.ef_version = ef_version
+        self.hop = hop
 
     def tree_flatten(self):
         return (self.ef, self.scale, self.step), (self.spec,
-                                                  self.ef_version)
+                                                  self.ef_version,
+                                                  self.hop)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         ef, scale, step = children
-        return cls(ef, scale, step, spec=aux[0], ef_version=aux[1])
+        # aux grew a trailing hop slot; treedefs pickled before that keep
+        # unflattening (hop=None).
+        hop = aux[2] if len(aux) > 2 else None
+        return cls(ef, scale, step, spec=aux[0], ef_version=aux[1],
+                   hop=hop)
 
     def _replace(self, **kw):
         d = {"ef": self.ef, "scale": self.scale, "step": self.step,
-             "spec": self.spec, "ef_version": self.ef_version}
+             "spec": self.spec, "ef_version": self.ef_version,
+             "hop": self.hop}
         d.update(kw)
         return CompressionState(**d)
 
     def __repr__(self):
+        hop = f", hop={self.hop}" if self.hop is not None else ""
         return (f"CompressionState(ef={jnp.shape(self.ef)}, "
-                f"scale={jnp.shape(self.scale)}, spec={self.spec})")
+                f"scale={jnp.shape(self.scale)}, spec={self.spec}{hop})")
 
 
-def init_state(compressor, length: int, n_scales: int) -> CompressionState:
+def init_state(compressor, length: int, n_scales: int,
+               hop: Optional[int] = None) -> CompressionState:
     """Fresh single-rank EF state for one flat buffer: zero residual,
     unit scales (``e=0`` -> ``2**0``; the delayed-scale update converges
     geometrically from any initialization because EF re-feeds what the
-    warmup steps clipped or zeroed), step 0."""
+    warmup steps clipped or zeroed), step 0.  ``hop`` tags a per-stage
+    state with its plan stage index (see ``planner.compiler``)."""
     return CompressionState(
         ef=jnp.zeros((int(length),), jnp.float32),
         scale=jnp.zeros((int(n_scales),), jnp.float32),
         step=jnp.zeros((1,), jnp.float32),
         spec=compressor.spec,
         ef_version=EF_VERSION,
+        hop=hop,
     )
 
 
@@ -104,11 +118,20 @@ def compression_layout(tree) -> Optional[dict]:
     states = iter_compression_states(tree)
     if not states:
         return None
-    return {
+    out = {
         "specs": sorted({s.spec for s in states}),
         "n_states": len(states),
         "ef_version": max(s.ef_version for s in states),
     }
+    # Per-hop states additionally pin WHICH stage carries WHICH spec
+    # (sorted "stage:spec" strings): swapping the int8 and fp8 hops of a
+    # plan yields the same spec set but a different layout, and the
+    # resume guard must refuse it.
+    hops = sorted(f"{s.hop}:{s.spec}" for s in states
+                  if s.hop is not None)
+    if hops:
+        out["hops"] = hops
+    return out
 
 
 __all__ = ["EF_VERSION", "CompressionState", "compression_layout",

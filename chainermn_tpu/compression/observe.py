@@ -49,6 +49,11 @@ class CompressionObs:
             self._residual = registry.gauge(
                 "compression_residual_norm",
                 "L2 norm of this rank's error-feedback residual")
+            self._sat = registry.gauge(
+                "compression_saturated_chunks",
+                "summed per-chunk saturation-flag count of the last "
+                "collective (nonzero = some rank clipped hard; the "
+                "delayed scale escalates next step)")
 
     def edge(self, phase: str, edge: str, seam: str, bucket: int,
              compressor: str, bits_per_param: float, bytes_saved: int,
@@ -84,6 +89,17 @@ class CompressionObs:
                 self.edge(phase, edge, seam, bucket, compressor,
                           bits_per_param, bytes_saved,
                           float(residual_norm))
+        return cb
+
+    def make_sat_callback(self, seam: str, bucket: int, compressor: str):
+        """A rank-gated callback recording the summed saturation-flag
+        count of one collective (the per-hop planner lane reports it per
+        stage).  Called with ``(rank_idx, sat_count, _dep)``."""
+
+        def cb(rank_idx, sat_count, _dep):
+            if int(rank_idx) == 0 and self.registry is not None:
+                self._sat.set(float(sat_count), seam=seam,
+                              bucket=str(bucket), compressor=compressor)
         return cb
 
 

@@ -169,10 +169,10 @@ class _ScaledQuantizer(Compressor):
     def n_chunks(self, length: int) -> int:
         return self._padded(length) // self.chunk_size
 
-    def init_state(self, length: int, world_size: int = 1):
+    def init_state(self, length: int, world_size: int = 1, hop=None):
         del world_size  # shape-independent; kept for API symmetry
         return _ef.init_state(self, self._padded(int(length)),
-                              self.n_chunks(int(length)))
+                              self.n_chunks(int(length)), hop=hop)
 
     def scale_per_pos(self, scale_e):
         return jnp.repeat(jnp.exp2(scale_e), self.chunk_size)

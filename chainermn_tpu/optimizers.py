@@ -317,9 +317,32 @@ def create_multi_node_optimizer(
     ``NoCompression(wire_dtype=...)`` reproduces the communicator-level
     ``allreduce_grad_dtype`` program bit for bit; the quantizers carry
     error-feedback state inside the optimizer state (initialize it with
-    :func:`init_opt_state`, which places the per-rank EF residual)."""
+    :func:`init_opt_state`, which places the per-rank EF residual).
+
+    ``compression`` may also be a :class:`~chainermn_tpu.planner.Plan`
+    whose stages carry per-hop ``Stage.compression`` specs (e.g.
+    ``compressed_two_dimensional(...)``): the gradient exchange executes
+    that plan with one EF state per quantized hop riding the optimizer
+    state as a stage-indexed dict — the DynamiQ per-hop path."""
     from chainermn_tpu.compression import base as _cbase
     from chainermn_tpu.compression import quantize as _cq
+    from chainermn_tpu.planner.compiler import plan_compressed_hops
+    from chainermn_tpu.planner.ir import Plan as _Plan
+    if isinstance(compression, _Plan):
+        if zero or double_buffering:
+            raise NotImplementedError(
+                "compression=<Plan> (per-hop) composes with neither "
+                "zero=True nor double_buffering=True — the per-hop EF "
+                "states ride the plain compressed-optimizer state slot")
+        if not plan_compressed_hops(compression,
+                                    communicator.plan_topology()):
+            raise ValueError(
+                f"compression plan {compression.name!r} has no quantizing "
+                "stages on this topology; pass the plan to "
+                "create_communicator(plan_table=...) instead, or add "
+                "Stage.compression specs")
+        return _CompressedOptimizer(actual_optimizer, communicator,
+                                    compression)
     compression = _cbase.resolve_compressor(compression)
     _deprecate_raw_wire_knob(communicator, compression)
     if zero and double_buffering:

@@ -23,8 +23,13 @@ from chainermn_tpu.planner.autotune import (
 )
 from chainermn_tpu.planner.compiler import (
     execute_plan,
+    init_plan_compression_states,
     plan_census_kinds,
+    plan_compressed_hops,
+    plan_dcn_bytes,
+    plan_stage_lengths,
     plan_wire_bytes,
+    plan_wire_dtypes,
 )
 from chainermn_tpu.planner.ir import (
     Plan,
@@ -58,9 +63,14 @@ __all__ = [
     "candidate_plans",
     "execute_plan",
     "flavor_plan",
+    "init_plan_compression_states",
     "load_plan",
     "plan_census_kinds",
+    "plan_compressed_hops",
+    "plan_dcn_bytes",
+    "plan_stage_lengths",
     "plan_wire_bytes",
+    "plan_wire_dtypes",
     "size_bucket",
     "validate_sweep_rows",
 ]

@@ -38,7 +38,7 @@ the census table is derived, not maintained.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -80,18 +80,169 @@ class _ShardFrame:
         self.strip = strip
 
 
-def _run_stages_flat(plan: Plan, topology: PlanTopology, buf):
-    """Apply the stage chain to one flat buffer."""
-    from chainermn_tpu.communicators import _packing
+def _quantizer_for(st: Stage):
+    """The stage's resolved compressor when it is a stateful quantizer
+    (int8/fp8); None for uncompressed and identity-compressed stages."""
+    if st.compression is None:
+        return None
+    from chainermn_tpu.compression import quantize as _cq
+    comp = st.compressor()
+    return comp if _cq.is_quantizing(comp) else None
 
-    shard_stack: List[_ShardFrame] = []
-    for st in plan.stages:
+
+def plan_compressed_hops(plan: Plan,
+                         topology: Optional[PlanTopology] = None) -> Dict:
+    """``{stage_index: Compressor}`` for every stage carrying a stateful
+    quantizer.  With a ``topology``, stages whose scope resolves to no
+    axes are dropped (the compiler skips them, so they hold no state)."""
+    hops = {}
+    for i, st in enumerate(plan.stages):
+        if topology is not None and not topology.scope_axes(st.scope):
+            continue
+        comp = _quantizer_for(st)
+        if comp is not None:
+            hops[i] = comp
+    return hops
+
+
+def plan_stage_lengths(plan: Plan, topology: PlanTopology,
+                       length: int) -> Dict[int, int]:
+    """Flat-buffer element count at ENTRY to each emitted stage — the
+    static mirror of ``_run_stages_flat``'s pad/shard bookkeeping, used
+    to size per-hop EF state (a compressed inter hop after a
+    reduce-scatter sees 1/intra of the packed buffer)."""
+    lengths: Dict[int, int] = {}
+    cur = int(length)
+    stack: List[Tuple[int, int]] = []  # (orig_len, padded_len)
+    for i, st in enumerate(plan.stages):
         axes = topology.scope_axes(st.scope)
         if not axes:
             continue
+        lengths[i] = cur
+        if st.op == "reduce-scatter":
+            size = topology.scope_size(st.scope)
+            padded = cur + (-cur) % size
+            stack.append((cur, padded))
+            cur = padded // size
+        elif st.op == "all-gather":
+            orig, _ = stack.pop()
+            cur = orig
+    return lengths
+
+
+def init_plan_compression_states(plan: Plan, topology: PlanTopology,
+                                 length: int) -> Optional[Dict]:
+    """Fresh per-hop EF states for ``plan`` over a packed buffer of
+    ``length`` float32 elements: ``{stage_index: CompressionState}``,
+    one per quantizing stage, each sized to the buffer AT that stage and
+    tagged with its stage index (``state.hop``) so the checkpoint
+    sidecar pins which hop carried which spec.  ``None`` when the plan
+    has no quantizing stages."""
+    hops = plan_compressed_hops(plan, topology)
+    if not hops:
+        return None
+    lengths = plan_stage_lengths(plan, topology, length)
+    states = {}
+    for i, comp in hops.items():
+        world = topology.scope_size(plan.stages[i].scope)
+        comp.clip_limit(world)  # fail early at unworkable scope sizes
+        states[i] = comp.init_state(lengths[i], world, hop=i)
+    return states
+
+
+def _compressed_psum(st: Stage, idx: int, axes, world: int, buf, state,
+                     obs):
+    """Lower one quantized all-reduce stage: EF-encode to wire codes,
+    psum the codes (and piggybacked saturation flags) IN wire
+    arithmetic over the scope axes, decode + delayed-scale update.
+    Returns ``(summed_f32_buffer, new_state)`` — sum semantics, same as
+    the psum it replaces, so the fused 1/world mean at unpack is
+    untouched."""
+    from chainermn_tpu.compression import quantize as _cq
+
+    comp = _quantizer_for(st)
+    m = int(buf.shape[0])
+    if int(state.ef.shape[0]) != comp._padded(m):
+        raise ValueError(
+            f"per-hop compression state for stage {idx} is sized for "
+            f"ef={int(state.ef.shape[0])} but the buffer at this stage "
+            f"has {m} elements (needs {comp._padded(m)}): build the "
+            "states with init_plan_compression_states(plan, topology, "
+            "packed_length) / comm.init_compression_state(grads)")
+    orig_dtype = buf.dtype
+    rank = lax.axis_index(_axis_arg(axes))
+    v = buf.astype(jnp.float32)
+    if obs is not None:
+        bpp = _cq.wire_bits_per_param(comp, m, world)
+        saved = (m * 4 - (comp._padded(m) + comp.n_chunks(m))
+                 * jnp.dtype(comp.wire).itemsize)
+        seam = f"plan:{st.scope}"
+        jax.debug.callback(
+            obs.make_callback("compress", "begin", seam, idx,
+                              comp.name, bpp, saved),
+            rank, 0.0, v[0])
+    codes, state = comp.compress(v, state, rank=rank, world_size=world)
+    if obs is not None:
+        rnorm = jnp.sqrt(jnp.sum(jnp.square(state.ef)))
+        jax.debug.callback(
+            obs.make_callback("compress", "end", seam, idx,
+                              comp.name, bpp, saved),
+            rank, rnorm, codes[0])
+    summed = lax.psum(codes, _axis_arg(axes))
+    if obs is not None:
+        jax.debug.callback(
+            obs.make_callback("decompress", "begin", seam, idx,
+                              comp.name, bpp, saved),
+            rank, 0.0, summed[0])
+    out, state = comp.decompress(summed, state, world_size=world)
+    if obs is not None:
+        mp = comp._padded(m)
+        sat = jnp.sum(summed[mp:].astype(jnp.float32))
+        jax.debug.callback(
+            obs.make_sat_callback(seam, idx, comp.name), rank, sat, out[0])
+        jax.debug.callback(
+            obs.make_callback("decompress", "end", seam, idx,
+                              comp.name, bpp, saved),
+            rank, 0.0, out[0])
+    return out[:m].astype(orig_dtype), state
+
+
+def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
+                     states: Optional[Dict] = None, obs=None):
+    """Apply the stage chain to one flat buffer.  ``states`` maps stage
+    index -> per-hop CompressionState for quantizing stages; returns
+    ``(buf, new_states)`` (``new_states`` empty when nothing is
+    stateful)."""
+    from chainermn_tpu.communicators import _packing
+
+    states = dict(states or {})
+    new_states: Dict = {}
+    shard_stack: List[_ShardFrame] = []
+    for i, st in enumerate(plan.stages):
+        axes = topology.scope_axes(st.scope)
+        if not axes:
+            continue
+        quant = _quantizer_for(st)
+        if quant is not None:
+            world = topology.scope_size(st.scope)
+            state = states.get(i)
+            if state is None:
+                # One-shot path (benchmark sweeps, candidate validation):
+                # a cold EF state built inside the trace, discarded by
+                # the caller.  Training seams thread persistent states.
+                state = quant.init_state(int(buf.shape[0]), world, hop=i)
+            buf, new_states[i] = _compressed_psum(
+                st, i, axes, world, buf, state, obs)
+            continue
         if st.op == "all-reduce":
-            buf = _with_wire(buf, st.wire_dtype,
-                             lambda b: lax.psum(b, _axis_arg(axes)))
+            if st.compression is not None:
+                # identity compressor: exactly the wire-dtype cast path
+                comp = st.compressor()
+                buf = _with_wire(buf, comp.wire_dtype,
+                                 lambda b: lax.psum(b, _axis_arg(axes)))
+            else:
+                buf = _with_wire(buf, st.wire_dtype,
+                                 lambda b: lax.psum(b, _axis_arg(axes)))
         elif st.op == "reduce-scatter":
             if len(axes) != 1:
                 raise PlanError(
@@ -143,7 +294,7 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf):
                              lambda b: lax.ppermute(b, axes[0], perm))
         else:  # pragma: no cover — ir validation rejects unknown ops
             raise PlanError(f"unknown stage op {st.op!r}")
-    return buf
+    return buf, new_states
 
 
 def _run_stages_leaf(plan: Plan, topology: PlanTopology, leaf):
@@ -174,7 +325,7 @@ def _run_stages_leaf(plan: Plan, topology: PlanTopology, leaf):
     return leaf
 
 
-def execute_plan(plan: Plan, comm, grads):
+def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
     """Run ``plan`` as ``comm``'s gradient mean — the one lowering every
     flavor's ``_allreduce_grad_traced`` now delegates to.
 
@@ -182,20 +333,50 @@ def execute_plan(plan: Plan, comm, grads):
     ``comm.plan_topology()`` (the shared Topology-derived descriptor —
     one source of truth for group sizes).  Must be called inside an SPMD
     region, like the methods it replaces.
+
+    ``states`` threads per-hop error-feedback state through quantizing
+    stages: a ``{stage_index: CompressionState}`` dict from
+    :func:`init_plan_compression_states`.  When given, the call returns
+    ``(mean_grads, new_states)``; when omitted, quantizing stages run
+    from a cold in-trace state (EF discarded — the one-shot
+    benchmark/validation path) and the return is just ``mean_grads``,
+    keeping every pre-existing call site unchanged.
     """
     from chainermn_tpu.communicators import _packing
 
     topology = comm.plan_topology()
     n = topology.size
+    has_quant = bool(plan_compressed_hops(plan, topology))
     if plan.packing == "leaf":
+        if states is not None:
+            raise PlanError(
+                f"plan {plan.name!r}: leaf packing carries no per-hop "
+                "compression state")
         return jax.tree.map(
             lambda g: _run_stages_leaf(plan, topology, g) / n, grads)
-    buffers, meta = _packing.pack(
-        grads,
-        comm_dtype=jnp.dtype(plan.wire_dtype)
-        if plan.wire_dtype is not None else None)
-    buffers = [_run_stages_flat(plan, topology, b) for b in buffers]
-    return _packing.unpack(buffers, meta, scale=1.0 / n)
+    # Quantizing plans exchange ONE float32 buffer (the quantizer's
+    # native dtype; per-stage wires still cast per hop) so EF state maps
+    # one-to-one onto the packed buffer.
+    comm_dtype = (jnp.dtype(plan.wire_dtype)
+                  if plan.wire_dtype is not None else None)
+    if has_quant and comm_dtype is None:
+        comm_dtype = jnp.float32
+    buffers, meta = _packing.pack(grads, comm_dtype=comm_dtype)
+    obs = None
+    if has_quant:
+        from chainermn_tpu.compression import observe as _cobs
+        obs = _cobs.get_compression_obs()
+    new_states: Dict = {}
+    out_buffers = []
+    for b in buffers:
+        b, st_out = _run_stages_flat(plan, topology, b, states=states,
+                                     obs=obs)
+        new_states.update(st_out)
+        out_buffers.append(b)
+    result = _packing.unpack(out_buffers, meta, scale=1.0 / n)
+    if states is not None:
+        return result, new_states
+    return result
 
 
 #: stage op -> HLO collective kind its default lowering compiles to
@@ -232,13 +413,69 @@ def plan_census_kinds(plan: Plan, topology: PlanTopology) -> tuple:
     return tuple(kinds)
 
 
+def plan_wire_dtypes(plan: Plan, topology: PlanTopology,
+                     dtype="float32") -> tuple:
+    """Expected on-wire numpy dtype NAME per emitted stage, aligned with
+    :func:`plan_census_kinds` — the per-hop census the lint rules
+    compare against compiled HLO.  A compressed stage's wire is its
+    compressor's (``int8`` / ``float8_e4m3fn`` / an identity codec's
+    ``wire_dtype``); otherwise the stage wire dtype, the plan wire
+    dtype, then the payload ``dtype``, in that order."""
+    payload = np.dtype(dtype).name if plan.wire_dtype is None \
+        else np.dtype(plan.wire_dtype).name
+    if plan_compressed_hops(plan, topology) and plan.wire_dtype is None:
+        payload = "float32"  # quantizing plans pack one f32 buffer
+    out = []
+    for st in plan.stages:
+        if not topology.scope_axes(st.scope):
+            continue
+        if st.compression is not None:
+            comp = st.compressor()
+            wire = getattr(comp, "wire", None) or \
+                getattr(comp, "wire_dtype", None)
+            out.append(np.dtype(str(wire)).name if wire else payload)
+        elif st.wire_dtype is not None:
+            out.append(np.dtype(st.wire_dtype).name)
+        else:
+            out.append(payload)
+    return tuple(out)
+
+
+def _stage_wire_elem_bytes(plan: Plan, st: Stage, elems: float,
+                           item: int) -> float:
+    """Bytes ``elems`` payload elements occupy on THIS stage's wire —
+    the per-stage dtype priority the compiler itself applies (stage
+    wire, then plan wire, then payload), extended with compressed-stage
+    pricing: a quantizing hop pays the compressor's wire width on the
+    chunk-grid-padded length PLUS one flag slot per chunk (the
+    saturation flags ride the same collective)."""
+    quant = _quantizer_for(st)
+    if quant is not None:
+        n = int(np.ceil(elems))
+        wire_item = np.dtype(quant.wire).itemsize
+        return float(quant._padded(n) + quant.n_chunks(n)) * wire_item
+    if st.compression is not None:  # identity codec
+        wd = st.compressor().wire_dtype
+        wire_item = np.dtype(wd).itemsize if wd else item
+        return elems * wire_item
+    wire_item = (np.dtype(st.wire_dtype).itemsize
+                 if st.wire_dtype else
+                 np.dtype(plan.wire_dtype).itemsize
+                 if plan.wire_dtype else item)
+    return elems * wire_item
+
+
 def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
                     dtype="float32") -> dict:
     """Static per-scope wire-cost model of a plan moving ``nbytes`` of
     ``dtype`` payload: bytes each scope's links carry per device, using
     ring costs (all-reduce 2x, reduce-scatter/all-gather 1x, p2p
-    1/size).  Used by the autotuner to break timing ties and by the docs
-    to explain WHY a plan wins a cell; not a substitute for measurement.
+    1/size).  Each stage is priced at ITS OWN wire width — stage
+    ``wire_dtype`` first, then the plan-level dtype, then the payload;
+    a quantizing stage at its compressor's wire width including the
+    chunk pad and per-chunk saturation-flag overhead.  Used by the
+    autotuner to break timing ties and by the docs to explain WHY a
+    plan wins a cell; not a substitute for measurement.
     """
     item = np.dtype(dtype).itemsize
     costs: dict = {}
@@ -248,11 +485,8 @@ def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
         if not axes:
             continue
         size = topology.scope_size(st.scope)
-        wire_item = (np.dtype(st.wire_dtype).itemsize
-                     if st.wire_dtype else
-                     np.dtype(plan.wire_dtype).itemsize
-                     if plan.wire_dtype else item)
-        stage_bytes = nbytes * frac * (wire_item / item)
+        elems = (nbytes / item) * frac
+        stage_bytes = _stage_wire_elem_bytes(plan, st, elems, item)
         if st.op == "all-reduce":
             moved = 2.0 * stage_bytes * (size - 1) / max(size, 1)
         elif st.op == "reduce-scatter":
@@ -275,4 +509,18 @@ def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
     return costs
 
 
-__all__ = ["execute_plan", "plan_census_kinds", "plan_wire_bytes"]
+def plan_dcn_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
+                   dtype="float32") -> float:
+    """Bytes a plan moves across the slow (DCN) boundary: the ``inter``
+    scope plus the ``all`` scope (a flat ring over every data axis
+    crosses the inter boundary, so its traffic is priced at DCN rates —
+    which is exactly why hierarchical plans exist).  The
+    ``dcn_wire_bytes`` perf budget and ``bench_allreduce --sweep``'s
+    per-hop shrink column read this."""
+    costs = plan_wire_bytes(plan, topology, nbytes, dtype=dtype)
+    return float(costs.get("inter", 0.0) + costs.get("all", 0.0))
+
+
+__all__ = ["execute_plan", "init_plan_compression_states",
+           "plan_census_kinds", "plan_compressed_hops", "plan_dcn_bytes",
+           "plan_stage_lengths", "plan_wire_bytes", "plan_wire_dtypes"]

@@ -150,6 +150,16 @@ class Stage:
     lowering: str = ""
     #: multicast root rank on the scope axes
     root: int = 0
+    #: per-hop compressor config for THIS stage (DynamiQ direction): a
+    #: ``resolve_compressor``-style dict like ``{"name": "int8",
+    #: "chunk_size": 1024}``.  The stage quantizes into the compressor's
+    #: wire dtype, sums IN the wire over the scope, and dequantizes at
+    #: the stage boundary; error feedback is per stage, keyed by stage
+    #: index (see ``execute_plan``).  Only legal on all-reduce stages —
+    #: in-wire summation is only defined for the psum lowering — and
+    #: mutually exclusive with ``wire_dtype`` (the compressor owns the
+    #: wire).
+    compression: Optional[Dict] = None
 
     def __post_init__(self):
         if self.op not in STAGE_OPS:
@@ -170,6 +180,37 @@ class Stage:
             except TypeError as e:
                 raise PlanError(
                     f"bad wire_dtype {self.wire_dtype!r}: {e}") from None
+        if self.compression is not None:
+            if not isinstance(self.compression, dict) or \
+                    not self.compression.get("name"):
+                raise PlanError(
+                    f"stage compression must be a config dict with a "
+                    f"'name' key, got {self.compression!r}")
+            if self.op != "all-reduce":
+                raise PlanError(
+                    f"compression only applies to all-reduce stages "
+                    f"(in-wire summation), not {self.op!r}")
+            if self.wire_dtype is not None:
+                raise PlanError(
+                    "a compressed stage's wire dtype is the compressor's "
+                    "wire; drop the stage wire_dtype")
+            object.__setattr__(self, "compression", dict(self.compression))
+            try:
+                self.compressor()
+            except PlanError:
+                raise
+            except Exception as e:
+                raise PlanError(
+                    f"bad stage compression {self.compression!r}: "
+                    f"{e}") from None
+
+    def compressor(self):
+        """The resolved :class:`~chainermn_tpu.compression.Compressor`
+        this stage quantizes with (None when uncompressed)."""
+        if self.compression is None:
+            return None
+        from chainermn_tpu.compression import resolve_compressor
+        return resolve_compressor(dict(self.compression))
 
     def to_dict(self) -> dict:
         d = {"op": self.op, "scope": self.scope}
@@ -179,6 +220,8 @@ class Stage:
             d["lowering"] = self.lowering
         if self.root:
             d["root"] = self.root
+        if self.compression is not None:
+            d["compression"] = dict(self.compression)
         return d
 
     @classmethod
@@ -186,7 +229,8 @@ class Stage:
         return cls(op=d["op"], scope=d.get("scope", "all"),
                    wire_dtype=d.get("wire_dtype"),
                    lowering=d.get("lowering", ""),
-                   root=int(d.get("root", 0)))
+                   root=int(d.get("root", 0)),
+                   compression=d.get("compression"))
 
 
 @dataclass(frozen=True)
@@ -223,6 +267,12 @@ class Plan:
             raise PlanError(f"plan {self.name!r} has no stages")
         if self.wire_dtype is not None and self.packing != "flat":
             raise PlanError("wire_dtype requires flat packing")
+        if self.packing != "flat" and any(
+                st.compression is not None for st in self.stages
+                if isinstance(st, Stage)):
+            raise PlanError(
+                f"plan {self.name!r}: per-hop compression requires flat "
+                "packing — the EF state is sized to the packed buffer")
         shard_stack = []
         for i, st in enumerate(self.stages):
             if not isinstance(st, Stage):

@@ -63,15 +63,47 @@ FLAVOR_NAMES = ("naive", "flat", "hierarchical", "two_dimensional",
                 "single_node", "non_cuda_aware", "xla")
 
 
+#: per-hop DCN compressor configs the candidate zoo sweeps.
+#: ``stochastic=False``: the sweep's correctness probe and the identity
+#: parity tests run ONE cold-state step, where deterministic rounding is
+#: exact on small-integer payloads; training seams that want the
+#: unbiased dither pass their own spec through ``Stage.compression``.
+DCN_COMPRESSORS = (
+    {"name": "int8", "stochastic": False},
+    {"name": "fp8", "stochastic": False},
+)
+
+
+def compressed_two_dimensional(comp: dict, wire_dtype: str = "bfloat16",
+                               name: str = None) -> Plan:
+    """The per-hop compressed 2-D decomposition (DynamiQ direction):
+    reduce-scatter on ICI in ``wire_dtype``, the shard's inter
+    all-reduce quantized by ``comp`` (the DCN hop carries 1-byte codes
+    with per-hop error feedback), masked-psum gather-back on ICI in
+    ``wire_dtype``."""
+    cname = comp.get("name", "?")
+    return Plan(
+        name=name or f"two_dimensional_{cname}_dcn", packing="flat",
+        stages=(Stage(op="reduce-scatter", scope="intra",
+                      wire_dtype=wire_dtype),
+                Stage(op="all-reduce", scope="inter", compression=comp),
+                Stage(op="all-gather", scope="intra",
+                      lowering="masked-psum", wire_dtype=wire_dtype)))
+
+
 def candidate_plans(topology: PlanTopology,
-                    wire_dtypes: tuple = ("bfloat16",)) -> List[Plan]:
+                    wire_dtypes: tuple = ("bfloat16",),
+                    dcn_compressors: tuple = DCN_COMPRESSORS) -> List[Plan]:
     """The autotuner's search space for one topology.
 
     Always includes every fixed flavor legal on the topology (so the
     tuned table is never worse than the best fixed flavor on the run it
     was tuned from), plus reduced-precision-wire variants of the flat
     decompositions — the knob the fixed zoo only exposes through the xla
-    flavor, and the main lever at bandwidth-bound message sizes.
+    flavor, and the main lever at bandwidth-bound message sizes — plus,
+    on multi-axis topologies whose inter scope can carry in-wire summed
+    codes, per-hop compressed variants (quantized DCN hop, reduced-wire
+    ICI hops).
     """
     multi_axis = len(topology.axes) >= 2 and topology.inter_size >= 1
     out: List[Plan] = [flavor_plan("naive"), flavor_plan("flat"),
@@ -94,6 +126,15 @@ def candidate_plans(topology: PlanTopology,
                         _ar("inter"),
                         Stage(op="all-gather", scope="intra",
                               lowering="masked-psum"))))
+    if multi_axis and topology.inter_size > 1:
+        from chainermn_tpu.compression import resolve_compressor
+        for comp in dcn_compressors:
+            try:
+                resolve_compressor(dict(comp)).clip_limit(
+                    topology.inter_size)
+            except ValueError:
+                continue  # too few code levels at this inter size
+            out.append(compressed_two_dimensional(dict(comp)))
     # De-duplicate by serialized form (xla with no wire == flat, etc.)
     seen: Dict[str, Plan] = {}
     for p in out:
@@ -103,4 +144,5 @@ def candidate_plans(topology: PlanTopology,
     return list(seen.values())
 
 
-__all__ = ["FLAVOR_NAMES", "candidate_plans", "flavor_plan"]
+__all__ = ["DCN_COMPRESSORS", "FLAVOR_NAMES", "candidate_plans",
+           "compressed_two_dimensional", "flavor_plan"]

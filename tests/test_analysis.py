@@ -251,6 +251,84 @@ def test_rule_census_drift(devices):
     assert not rep2.findings
 
 
+def test_rule_census_drift_per_hop_compressed_dtype(devices):
+    """Per-hop census: a compressed plan whose quantized DCN hop runs
+    f32 in the compiled program (compression silently off) is an error
+    naming the hop — and the real compiled plan passes, per-hop dtypes
+    included."""
+    from chainermn_tpu.analysis import schedule_from_hlo as _from_hlo
+    from chainermn_tpu.planner import PlanTable, PlanTopology, size_bucket
+    from chainermn_tpu.planner.plans import compressed_two_dimensional
+
+    plan = compressed_two_dimensional({"name": "int8",
+                                       "stochastic": False})
+    # clean: an auto communicator whose tuned table pins the compressed
+    # plan at the census probe's payload (1024 f32 = 4 KiB) compiles
+    # the plan for real, so kinds and per-hop wires (bf16 RS, s8
+    # in-wire-summed AR, bf16 gather-back) all line up
+    topo = PlanTopology(axes=(("inter", 2), ("intra", 4)))
+    table = PlanTable()
+    table.put(topo, "float32", size_bucket(1024 * 4), plan)
+    comm = chainermn_tpu.create_communicator("auto", intra_size=4,
+                                             plan_table=table)
+    rep = lint_step(None, comm=comm, plan=plan, census=True,
+                    rules=["census-drift"], raise_on_error=False)
+    assert not rep.findings, rep.findings
+    assert "census-drift" not in rep.skipped, rep.skipped
+
+    # broken fixture: same kinds, but the inter hop moves f32 — the
+    # schedule a program with the quantizer silently dropped compiles to
+    broken = _from_hlo("""
+HloModule m
+ENTRY e {
+  p0 = f32[1024]{0} parameter(0)
+  rs = f32[256]{0} reduce-scatter(f32[1024]{0} p0), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, to_apply=add
+  ar1 = f32[256]{0} all-reduce(f32[256]{0} rs), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=add
+  ar2 = f32[1024]{0} all-reduce(f32[1024]{0} ar1), replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=add
+  ROOT t = tuple(ar2)
+}
+""")
+    ctx = SimpleNamespace(census_schedule=broken, plan=plan, comm=comm,
+                          inter_size=2, flavor=None, name="synthetic")
+    findings = get_rule("census-drift").run(ctx)
+    assert [f.rule for f in findings] == ["census-drift"], findings
+    f = findings[0]
+    assert f.details["stage"] == 1
+    assert f.details["expected_dtype"] == "s8"
+    assert f.details["observed_dtype"] == "f32"
+
+
+def test_rule_wire_dtype_mismatch_per_hop_compressed_plan(devices):
+    """A plan stage carrying a per-hop compression spec expects the
+    COMPRESSOR's wire among the compiled collective dtypes: the real
+    compressed program passes; the same spec audited against an
+    uncompressed program fires once per missing wire, s8 included."""
+    from chainermn_tpu.analysis.lint import allreduce_hlo
+    from chainermn_tpu.analysis import schedule_from_hlo as _from_hlo
+    from chainermn_tpu.planner.plans import (compressed_two_dimensional,
+                                             flavor_plan)
+
+    comm = chainermn_tpu.create_communicator("two_dimensional",
+                                             intra_size=4)
+    plan = compressed_two_dimensional({"name": "int8",
+                                       "stochastic": False})
+    hlo = allreduce_hlo(comm, plan=plan)
+    ctx = SimpleNamespace(hlo_schedule=_from_hlo(hlo), hlo_text=hlo,
+                          plan=plan, fsdp_meta=None, name="t")
+    assert not get_rule("wire-dtype-mismatch").run(ctx)
+
+    # broken fixture: the compiled program is the UNCOMPRESSED 2-D
+    # decomposition — no s8 codes (and no bf16 seam) anywhere
+    hlo2 = allreduce_hlo(comm, plan=flavor_plan("two_dimensional"))
+    ctx2 = SimpleNamespace(hlo_schedule=_from_hlo(hlo2), hlo_text=hlo2,
+                           plan=plan, fsdp_meta=None, name="t")
+    findings = get_rule("wire-dtype-mismatch").run(ctx2)
+    assert {f.details["expected_dtype"] for f in findings} \
+        == {"s8", "bf16"}, findings
+    s8 = [f for f in findings if f.details["expected_dtype"] == "s8"]
+    assert len(s8) == 1 and "compressor 'int8'" in s8[0].details["declared"]
+
+
 def test_rule_unpinned_transpose(devices):
     """A raw allreduce of the per-rank loss, differentiated inside the
     SPMD body (the PR 1 bug class: gradients inflate by world size),

@@ -47,12 +47,18 @@ from chainermn_tpu.planner import (
     candidate_plans,
     execute_plan,
     flavor_plan,
+    init_plan_compression_states,
     load_plan,
     plan_census_kinds,
+    plan_compressed_hops,
+    plan_dcn_bytes,
+    plan_stage_lengths,
     plan_wire_bytes,
+    plan_wire_dtypes,
     size_bucket,
     validate_sweep_rows,
 )
+from chainermn_tpu.planner.plans import compressed_two_dimensional
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -138,6 +144,18 @@ class TestIR:
         # unknown packing
         lambda: Plan(name="pack", packing="columnar",
                      stages=(Stage(op="all-reduce"),)),
+        # compression is an all-reduce-only property (in-wire summation)
+        lambda: Stage(op="reduce-scatter", compression={"name": "int8"}),
+        # the compressor owns the wire; a stage wire_dtype conflicts
+        lambda: Stage(op="all-reduce", wire_dtype="bfloat16",
+                      compression={"name": "int8"}),
+        # compression config must name its compressor
+        lambda: Stage(op="all-reduce", compression={"chunk_size": 64}),
+        # ...and the name must resolve
+        lambda: Stage(op="all-reduce", compression={"name": "zstd"}),
+        # per-hop EF state is sized to the packed buffer: flat only
+        lambda: Plan(name="leafcomp", packing="leaf", stages=(
+            Stage(op="all-reduce", compression={"name": "int8"}),)),
     ])
     def test_invalid_plans_rejected(self, bad):
         with pytest.raises(PlanError):
@@ -331,6 +349,190 @@ class TestCompilerParity:
                                 grads)
             np.testing.assert_allclose(np.asarray(out), (n - 1) / 2.0,
                                        rtol=1e-2, err_msg=plan.name)
+
+
+# ---------------------------------------------------------------------------
+# Per-hop compression: quantize the DCN hop, not the whole collective
+# ---------------------------------------------------------------------------
+
+INT8_SPEC = {"name": "int8", "stochastic": False}
+
+
+class TestPerHopCompression:
+    def test_compressed_plan_round_trips(self):
+        p = compressed_two_dimensional(dict(INT8_SPEC))
+        assert p.stages[1].compression["name"] == "int8"
+        assert Plan.from_dict(json.loads(json.dumps(p.to_dict()))) == p
+        assert Plan.from_json(p.to_json()) == p
+
+    def test_candidate_plans_include_compressed_hops(self):
+        names = [p.name for p in candidate_plans(TOPO_2D)]
+        assert "two_dimensional_int8_dcn" in names
+        assert "two_dimensional_fp8_dcn" in names
+        # a single-axis topology has no inter hop to compress
+        one = PlanTopology(axes=(("data", 8),))
+        assert not any(p.name.endswith("_dcn") for p in candidate_plans(one))
+        # int8 runs out of code levels per rank (127 // 128 < 2) at a
+        # wide inter scope; fp8 (max_code 448) survives
+        wide = PlanTopology(axes=(("inter", 128), ("intra", 2)))
+        wide_names = [p.name for p in candidate_plans(wide)]
+        assert "two_dimensional_int8_dcn" not in wide_names
+        assert "two_dimensional_fp8_dcn" in wide_names
+
+    def test_stage_lengths_and_state_sizing(self):
+        p = compressed_two_dimensional(dict(INT8_SPEC))
+        # 37 pads to 40 for the intra-4 reduce-scatter; the inter hop
+        # (and the gather-back) see the 10-element shard
+        assert plan_stage_lengths(p, TOPO_2D, 37) == {0: 37, 1: 10, 2: 10}
+        hops = plan_compressed_hops(p, TOPO_2D)
+        assert list(hops) == [1] and hops[1].name == "int8"
+        # the inter scope vanishes on a single-axis topology: no state
+        one = PlanTopology(axes=(("data", 8),))
+        assert plan_compressed_hops(p, one) == {}
+        states = init_plan_compression_states(p, TOPO_2D, 37)
+        assert set(states) == {1}
+        st = states[1]
+        q = hops[1]
+        assert st.hop == 1 and st.spec == q.spec
+        assert st.ef.shape == (q._padded(10),)
+        # uncompressed plans carry no state
+        assert init_plan_compression_states(
+            flavor_plan("two_dimensional"), TOPO_2D, 37) is None
+
+    def test_per_hop_wire_dtypes(self):
+        p = compressed_two_dimensional(dict(INT8_SPEC))
+        assert plan_wire_dtypes(p, TOPO_2D) == \
+            ("bfloat16", "int8", "bfloat16")
+        fp8 = compressed_two_dimensional(
+            {"name": "fp8", "stochastic": False})
+        assert plan_wire_dtypes(fp8, TOPO_2D)[1] == "float8_e4m3fn"
+
+    def test_per_stage_wire_dtype_pricing(self):
+        """Each stage is priced at ITS OWN wire width: a bf16 wire on
+        the two ICI legs halves the intra cost and leaves the f32 inter
+        leg untouched (the r06 plan-table selections rest on exactly
+        this pricing, unchanged by the compressed-stage extension)."""
+        nbytes = 1 << 20
+        plain = plan_wire_bytes(flavor_plan("two_dimensional"), TOPO_2D,
+                                nbytes)
+        mixed = plan_wire_bytes(Plan(name="m", packing="flat", stages=(
+            Stage(op="reduce-scatter", scope="intra",
+                  wire_dtype="bfloat16"),
+            Stage(op="all-reduce", scope="inter"),
+            Stage(op="all-gather", scope="intra", lowering="masked-psum",
+                  wire_dtype="bfloat16"))), TOPO_2D, nbytes)
+        assert mixed["intra"] == pytest.approx(plain["intra"] / 2)
+        assert mixed["inter"] == pytest.approx(plain["inter"])
+
+    def test_compressed_hop_pricing_and_dcn_shrink(self):
+        """A quantizing stage is priced at its compressor's wire width
+        on the chunk-padded shard plus one flag slot per chunk — and the
+        resulting DCN-scope shrink vs the bf16-wire flat plan clears the
+        3.5x acceptance bar with a wide margin at 1 MiB."""
+        nbytes = 1 << 20
+        comp = compressed_two_dimensional(dict(INT8_SPEC))
+        q = comp.stages[1].compressor()
+        shard = (nbytes // 4) // TOPO_2D.intra_size
+        want_inter = (2.0 * (q._padded(shard) + q.n_chunks(shard))
+                      * np.dtype(q.wire).itemsize
+                      * (TOPO_2D.inter_size - 1) / TOPO_2D.inter_size)
+        costs = plan_wire_bytes(comp, TOPO_2D, nbytes)
+        assert costs["inter"] == pytest.approx(want_inter)
+        assert plan_dcn_bytes(comp, TOPO_2D, nbytes) == \
+            pytest.approx(want_inter)
+        baseline = plan_dcn_bytes(
+            Plan(name="flat_bfloat16", packing="flat",
+                 wire_dtype="bfloat16", stages=(Stage(op="all-reduce"),)),
+            TOPO_2D, nbytes)
+        assert baseline / plan_dcn_bytes(comp, TOPO_2D, nbytes) >= 3.5
+
+    def test_identity_compressor_bit_for_bit(self, devices):
+        """A ``{"name": "none", "wire_dtype": ...}`` stage compression
+        IS the stage wire_dtype program — identical census, bit-for-bit
+        equal outputs (the per-hop seam degrades to the cast seam)."""
+        comm = make_comm("naive")
+        n = comm.size
+        ident = Plan(name="ident", packing="flat", stages=(
+            Stage(op="all-reduce",
+                  compression={"name": "none", "wire_dtype": "bfloat16"}),))
+        knob = Plan(name="knob", packing="flat", stages=(
+            Stage(op="all-reduce", wire_dtype="bfloat16"),))
+        rng = np.random.RandomState(7)
+        grads = jnp.asarray(rng.randn(n, 333), jnp.float32)
+        assert _census(comm.compiled_hlo(
+            lambda g: execute_plan(ident, comm, g), grads)) == \
+            _census(comm.compiled_hlo(
+                lambda g: execute_plan(knob, comm, g), grads))
+        out_i = comm.run_spmd(lambda g: execute_plan(ident, comm, g),
+                              grads)
+        out_k = comm.run_spmd(lambda g: execute_plan(knob, comm, g),
+                              grads)
+        assert out_i.dtype == out_k.dtype
+        assert np.array_equal(np.asarray(out_i), np.asarray(out_k))
+
+    def test_execute_plan_threads_per_hop_state(self, devices):
+        """states={stage: CompressionState} in, (mean, new_states) out:
+        the EF step advances, identity (spec/hop) survives, and the
+        one compressed hop still computes the gradient mean."""
+        comm = make_comm("naive")
+        n = comm.size
+        plan = compressed_two_dimensional(dict(INT8_SPEC))
+        length = 2048
+        states = init_plan_compression_states(plan, comm.plan_topology(),
+                                              length)
+        st = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (n,) + a.shape), states)
+        grads = jnp.tile(jnp.arange(n, dtype=jnp.float32).reshape(n, 1),
+                         (1, length))
+        out, new = comm.run_spmd(
+            lambda g, s: execute_plan(plan, comm, g, states=s), grads, st)
+        np.testing.assert_allclose(np.asarray(out), (n - 1) / 2.0,
+                                   rtol=2e-2)
+        assert set(new) == {1}
+        assert float(np.asarray(new[1].step)[0][0]) == 1.0
+        assert new[1].spec == states[1].spec and new[1].hop == 1
+
+    def test_mis_sized_state_fails_loudly(self, devices):
+        comm = make_comm("naive")
+        n = comm.size
+        spec = dict(INT8_SPEC, chunk_size=64)
+        plan = compressed_two_dimensional(spec)
+        bad = init_plan_compression_states(plan, comm.plan_topology(), 64)
+        st = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (n,) + a.shape), bad)
+        grads = jnp.ones((n, 2048), jnp.float32)
+        with pytest.raises(ValueError,
+                           match="init_plan_compression_states"):
+            comm.run_spmd(
+                lambda g, s: execute_plan(plan, comm, g, states=s),
+                grads, st)
+
+    def test_leaf_plan_rejects_states(self, devices):
+        comm = make_comm("naive")
+        with pytest.raises(PlanError, match="leaf packing"):
+            execute_plan(flavor_plan("naive"), comm,
+                         jnp.ones((8,)), states={})
+
+    def test_autotune_selects_compressed_plan_from_committed_sweep(self):
+        """Acceptance: on the committed r08 sweep (8-device CPU mesh,
+        modeled 0.03 GB/s DCN) the tuned table picks the int8-DCN plan
+        in at least one cell, with the per-hop spec surviving the
+        table round-trip."""
+        with open(os.path.join(
+                REPO, "ALLREDUCE_SWEEP_COMPRESSED_r08.json")) as f:
+            sweep = json.load(f)
+        table, comparison = autotune_from_rows(sweep["rows"])
+        topo = PlanTopology.from_key(sweep["topology"])
+        tuned = table.lookup(topo, "float32", 64 << 10)
+        assert tuned.name == "two_dimensional_int8_dcn"
+        assert tuned.stages[1].compression["name"] == "int8"
+        wins = [c for c in comparison
+                if c["tuned_plan"].endswith("_dcn")
+                and c["speedup"] is not None and c["speedup"] > 1.0]
+        assert wins, comparison
+        # ...and the committed artifact's own DCN summary clears the
+        # >=3.5x inter-hop shrink acceptance bar at the largest payload
+        assert sweep["dcn_largest"]["shrink_x"] >= 3.5
 
 
 # ---------------------------------------------------------------------------
@@ -574,6 +776,33 @@ class TestPerfGateCLI:
                         "--root", str(tmp_path)])
         assert r2.returncode == 1
         assert "FAIL" in r2.stderr
+
+    def test_budget_gate_lower_direction(self, tmp_path):
+        """direction="lower" budgets (wire bytes, latency) regress when
+        the value climbs ABOVE budget."""
+        budgets = tmp_path / "budgets.json"
+        budgets.write_text(json.dumps({
+            "schema": "perf_budgets/v1", "max_regression_pct": 3.0,
+            "metrics": [{"name": "wire", "artifact": "ART_*.json",
+                         "key": "dcn.bytes", "budget": 100.0,
+                         "direction": "lower"}]}))
+        art = tmp_path / "ART_r01.json"
+        art.write_text(json.dumps({"dcn": {"bytes": 99.0}}))
+        r = _run_gate(["--budgets", str(budgets), "--root", str(tmp_path)])
+        assert r.returncode == 0, r.stderr[-2000:]
+        art.write_text(json.dumps({"dcn": {"bytes": 110.0}}))  # +10%
+        r2 = _run_gate(["--budgets", str(budgets),
+                        "--root", str(tmp_path)])
+        assert r2.returncode == 1
+        assert "FAIL" in r2.stderr
+
+    def test_committed_compressed_sweep_passes_the_gate(self):
+        """The committed r08 compressed sweep wins cells through the
+        same CLI the runbook's COMPRESSED_PLAN leg drives."""
+        sweep = os.path.join(REPO, "ALLREDUCE_SWEEP_COMPRESSED_r08.json")
+        r = _run_gate(["--planner", sweep])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.loads(r.stdout.splitlines()[-1])["tuned_wins"] >= 1
 
     def test_budget_gate_missing_artifact_skips_unless_strict(
             self, tmp_path):

@@ -132,6 +132,28 @@ run 0 "$OUT/PLANNER_GATE_$ROUND.json" \
         && $PY_TPU tools/perf_gate.py --planner '$OUT/ALLREDUCE_SWEEP_$ROUND.json' \
             --table '$OUT/PLAN_TABLE_$ROUND.json' --out '$OUT/PLANNER_GATE_$ROUND.json'"
 
+# ---- per-hop compressed plans: sweep -> autotune -> gate --------------
+# Same pipeline as the PLANNER leg but with the compressed-inter-hop
+# candidates (int8/fp8 DCN codes, bf16 ICI) in the sweep and a modeled
+# DCN serialization term added to each row's time (--dcn-gbps; raw
+# timings kept in us_measured).  0.03 GB/s is the CPU-host validation
+# stress setting — the quantizer's CPU compute cost swamps any realistic
+# modeled DCN, so only an aggressively slow link lets a compressed plan
+# win a cell here; on a slice, re-run WITHOUT the env override and
+# WITHOUT --dcn-gbps to tune on measured ICI/DCN (docs/compression.md
+# "Per-hop compression").  The sweep artifact also carries the per-plan
+# DCN-scope wire-byte table the dcn_wire_bytes budget reads.
+run 0 "$OUT/PLANNER_GATE_COMPRESSED_$ROUND.json" \
+    "compressed-hop planner gate: sweep incl. int8/fp8-DCN plans under modeled slow DCN, require a compressed plan to win at least one cell" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_allreduce.py \
+            --sweep '$OUT/ALLREDUCE_SWEEP_COMPRESSED_$ROUND.json' \
+            --intra-size 4 --dcn-gbps 0.03 --iters 10 --warmup 2 > /dev/null \
+        && $PY_TPU tools/perf_gate.py \
+            --planner '$OUT/ALLREDUCE_SWEEP_COMPRESSED_$ROUND.json' \
+            --table '$OUT/PLAN_TABLE_COMPRESSED_$ROUND.json' \
+            --out '$OUT/PLANNER_GATE_COMPRESSED_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

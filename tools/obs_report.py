@@ -14,7 +14,10 @@ schema — and prints:
 * bench results            (``bench`` / ``bench_allreduce`` lines);
 * compression lane         (``compression_*`` metric lines — wire
                             bits/param, bytes saved, EF residual; also
-                            available alone via ``--compression``).
+                            available alone via ``--compression``.
+                            ``plan:*`` seams from a per-hop compressed
+                            plan get an extra per-stage table: wire
+                            bytes moved + saturation per hop).
 
 ``--flight`` switches to hang-dump mode: merge the per-rank
 ``flight_<rank>.json`` files a watchdog (or crash handler) wrote into one
@@ -177,7 +180,15 @@ def compression_section(records: List[dict]) -> str:
     bits/param, the implied ratio vs an f32 wire, cumulative bytes kept
     off the wire, and the error-feedback residual norm (the convergence
     health signal: decaying/flat-low is healthy, growing means the wire
-    is too narrow for the gradient stream)."""
+    is too narrow for the gradient stream).
+
+    ``plan:*`` seams (a compiled multi-hop plan with per-stage
+    compression, docs/collective_planner.md) additionally get a per-hop
+    table: the ``bucket`` label is the plan's stage index, so the lane
+    shows each compressed hop's wire width, the cumulative bytes it
+    actually moved, and the ``compression_saturated_chunks`` gauge —
+    nonzero saturation on one stage means THAT hop's wire clipped hard
+    last collective (its delayed scale escalates next step)."""
     latest = _latest_metric_lines(records)
     series: Dict[tuple, dict] = {}
     for (name, labels), r in latest.items():
@@ -193,6 +204,8 @@ def compression_section(records: List[dict]) -> str:
             d["saved"] = r.get("value", 0.0)
         elif name == "compression_residual_norm":
             d["residual"] = r.get("value")
+        elif name == "compression_saturated_chunks":
+            d["sat"] = r.get("value")
     if not series:
         return ("compression: no compression_* metrics "
                 "(wire uncompressed or observability off)")
@@ -206,9 +219,34 @@ def compression_section(records: List[dict]) -> str:
             _fmt_bytes(d.get("saved", 0.0)) if "saved" in d else "-",
             f"{d['residual']:.3e}" if d.get("residual") is not None else "-",
         ])
-    return "compression summary\n" + _table(
+    out = "compression summary\n" + _table(
         ["seam", "bucket", "compressor", "bits/param", "vs f32",
          "bytes saved", "ef residual"], rows)
+
+    # per-hop plan lane: the bucket label of a plan:* seam is the stage
+    # index inside the compiled plan, and saved = (f32 - wire) bytes, so
+    # wire = saved * bits / (32 - bits) recovers the bytes the hop
+    # actually moved (cumulative, like the saved counter)
+    hop_rows = []
+    for (seam, bucket, comp), d in sorted(series.items()):
+        if not seam.startswith("plan:"):
+            continue
+        bits, saved, sat = d.get("bits"), d.get("saved"), d.get("sat")
+        wire = (saved * bits / (32.0 - bits)
+                if saved is not None and bits and bits < 32.0 else None)
+        hop_rows.append([
+            str(bucket), seam.split(":", 1)[1], comp,
+            f"{bits:.2f}" if bits is not None else "-",
+            _fmt_bytes(wire) if wire is not None else "-",
+            _fmt_bytes(saved) if saved is not None else "-",
+            f"{int(sat)}" + (" << CLIPPING" if sat else "")
+            if sat is not None else "-",
+        ])
+    if hop_rows:
+        out += "\n\nper-hop plan lane\n" + _table(
+            ["stage", "scope", "compressor", "bits/param", "wire bytes",
+             "bytes saved", "sat chunks"], hop_rows)
+    return out
 
 
 def serving_section(records: List[dict]) -> str:

@@ -91,8 +91,20 @@ def check_budgets(args):
                 rows.append(row)
                 continue
             row["value"] = value
-            # all tracked metrics are higher-is-better throughputs
-            reg = (row["budget"] - value) / row["budget"] * 100.0
+            # metrics default to higher-is-better throughputs; a metric
+            # with "direction": "lower" (wire bytes, latency) regresses
+            # when the value climbs ABOVE budget instead
+            direction = m.get("direction", "higher")
+            if direction not in ("higher", "lower"):
+                row["status"] = f"bad direction {direction!r}"
+                failed += 1
+                rows.append(row)
+                continue
+            row["direction"] = direction
+            if direction == "lower":
+                reg = (value - row["budget"]) / row["budget"] * 100.0
+            else:
+                reg = (row["budget"] - value) / row["budget"] * 100.0
             row["regression_pct"] = round(reg, 2)
             if reg > max_reg:
                 row["status"] = "FAIL"
