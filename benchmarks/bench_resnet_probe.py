@@ -18,9 +18,19 @@ measured subtraction, not a guess from trace categories:
   remat       — full step with every residual block rematerialized
                 (nn.remat): prices whether trading HBM activation traffic
                 for recompute moves the memory-bound stages
+  fusednorm   — full step with every BatchNorm(+ReLU) boundary running
+                the fused Pallas kernels (ops.FusedBatchNormAct): the
+                round-9 countermeasure for the BN-boundary HBM traffic
+                that rounds 2-5 pinned as the deficit
 
 Run on the real chip:  python benchmarks/bench_resnet_probe.py
 Each variant reports ms/step and img/s; deltas vs `full` are printed.
+``--json``/``--out`` additionally emit a ``resnet_probe/v1`` artifact
+(committed as RESNET_PROBE_r09.json) carrying the variant rows plus a
+deterministic ``traffic`` section — ``ops.resnet_bn_traffic_bytes`` at
+the canonical b=256/224 shapes — which the ``resnet_bn_traffic_bytes``
+perf-gate budget reads (``traffic.fused_total_bytes``).  Timing rows off
+TPU are marked ``smoke``; the traffic model is backend-independent.
 
 ``--stages`` switches to per-stage isolation mode: each ResNet-50 stage's
 blocks run fwd+bwd alone on a synthetic activation (device-time ms +
@@ -158,11 +168,21 @@ def run_stage_isolation(args):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--image", type=int, default=224)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--variants", default="full,nostats,nonorm,fwdonly,fwdbwd")
     p.add_argument("--stages", action="store_true",
                    help="per-stage isolation + pad128 lane probe instead "
                         "of step variants")
+    p.add_argument("--json", action="store_true",
+                   help="emit the resnet_probe/v1 artifact on stdout")
+    p.add_argument("--out", default=None,
+                   help="write the resnet_probe/v1 artifact to this path "
+                        "(implies --json)")
+    p.add_argument("--traffic-batch", type=int, default=256,
+                   help="batch for the deterministic BN-traffic model "
+                        "section (canonical 256 regardless of --batch so "
+                        "the perf-gate budget is smoke-run independent)")
     args = p.parse_args()
 
     import flax.linen as nn
@@ -218,7 +238,7 @@ def main():
         return run_stage_isolation(args)
 
     n_classes = 1000
-    image = 224
+    image = args.image
     comm = chainermn_tpu.create_communicator(
         "xla", allreduce_grad_dtype="bfloat16")
 
@@ -228,7 +248,7 @@ def main():
     batch = put_global_batch(comm, (x, y))
 
     known_variants = {"full", "nostats", "nonorm", "fwdonly", "fwdbwd",
-                      "s2d", "remat"}
+                      "s2d", "remat", "fusednorm"}
     wanted = args.variants.split(",")
     unknown = set(wanted) - known_variants
     if unknown:
@@ -238,8 +258,9 @@ def main():
                          f"available: {sorted(known_variants)}")
     results = {}
     for variant in wanted:
-        norm_cls = {"nostats": ConstStatBN, "nonorm": IdentityNorm}.get(
-            variant)
+        from chainermn_tpu.ops import FusedBatchNormAct
+        norm_cls = {"nostats": ConstStatBN, "nonorm": IdentityNorm,
+                    "fusednorm": FusedBatchNormAct}.get(variant)
         kw = dict(num_classes=n_classes, dtype=jnp.bfloat16)
         if norm_cls is not None:
             kw["norm_cls"] = norm_cls
@@ -300,13 +321,51 @@ def main():
         dt = time_step(step, step_args, args.steps, warmup=4)
         img_s = args.batch / dt
         results[variant] = dt
-        log(f"{variant:8s}  {dt*1e3:7.2f} ms/step   {img_s:8.1f} img/s")
+        log(f"{variant:9s}  {dt*1e3:7.2f} ms/step   {img_s:8.1f} img/s")
 
     if "full" in results:
         base = results["full"]
         for v, dt in results.items():
             if v != "full":
-                log(f"delta full-{v:8s} = {1e3*(base-dt):7.2f} ms")
+                log(f"delta full-{v:9s} = {1e3*(base-dt):7.2f} ms")
+
+    if args.json or args.out:
+        import json
+
+        from chainermn_tpu.ops import resnet_bn_traffic_bytes
+
+        smoke = jax.default_backend() != "tpu"
+        base = results.get("full")
+        doc = {
+            "schema": "resnet_probe/v1",
+            "backend": jax.default_backend(),
+            # timing rows off TPU are dispatch smoke, never official
+            "smoke": smoke,
+            "batch": args.batch,
+            "image": image,
+            "steps": args.steps,
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "variants": {
+                v: {
+                    "ms_per_step": round(dt * 1e3, 3),
+                    "img_per_sec": round(args.batch / dt, 1),
+                    **({"delta_vs_full_ms": round((base - dt) * 1e3, 3)}
+                       if base is not None and v != "full" else {}),
+                }
+                for v, dt in results.items()
+            },
+            # deterministic modeled HBM bytes at the canonical ResNet-50
+            # boundary shapes — what the resnet_bn_traffic_bytes perf-gate
+            # budget reads (key: traffic.fused_total_bytes).
+            "traffic": resnet_bn_traffic_bytes(args.traffic_batch),
+        }
+        payload = json.dumps(doc, indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload + "\n")
+            log(f"wrote {args.out}")
+        else:
+            print(payload)
 
 
 if __name__ == "__main__":

@@ -565,6 +565,97 @@ def bench_resnet50_hier():
     return row
 
 
+# --------------------------------------------------------------------------
+# --tune-remat: remat-policy autotuner over the ResNet configs
+# --------------------------------------------------------------------------
+def tune_remat(repeats=1):
+    """Sweep the ``models.resnet.REMAT_POLICIES`` zoo (none / per-block
+    ``nn.remat`` / norm-boundary-only checkpointing) over the ResNet
+    configs with the fused normalization path enabled, and select the
+    per-config policy from measured step time — the same pick-from-
+    measurement discipline as the PR-6 collective-plan autotuner, one
+    level down (recompute-vs-HBM instead of wire-vs-compute).
+
+    Emits a ``remat_tune/v1`` artifact (committed as REMAT_TUNE_r09.json;
+    re-run on a slice for the on-chip selection — CPU rows are smoke).
+    Doubling as the fused-path end-to-end check: every swept row runs the
+    full ``make_train_step`` (fwd+bwd+allreduce+update) with
+    ``ops.FusedBatchNormAct`` at every norm boundary.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet, ResNet50
+    from chainermn_tpu.models.resnet import REMAT_POLICIES, BasicBlock
+    from chainermn_tpu.ops import FusedBatchNormAct
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    def model_kw(policy):
+        base = dict(norm_cls=FusedBatchNormAct, remat_policy=policy)
+        if on_tpu:
+            return (ResNet50(num_classes=1000, dtype=jnp.bfloat16, **base),
+                    dict(image=224, n_classes=1000, per_chip_batch=128,
+                         steps=10, warmup=3))
+        return (ResNet(stage_sizes=(1, 1), block_cls=BasicBlock,
+                       num_filters=8, num_classes=10, **base),
+                dict(image=32, n_classes=10, per_chip_batch=8,
+                     steps=3, warmup=1))
+
+    def mk_xla():
+        return chainermn_tpu.create_communicator(
+            "xla", allreduce_grad_dtype="bfloat16" if on_tpu else None)
+
+    def mk_hier():
+        n = len(_need_devices(4))
+        return chainermn_tpu.create_communicator(
+            "hierarchical", intra_size=n // 2)
+
+    sweeps = {}
+    for config, mk_comm in (("resnet50_xla", mk_xla),
+                            ("resnet50_hier", mk_hier)):
+        rows = {}
+        for policy in REMAT_POLICIES:
+            model, kw = model_kw(policy)
+            comm = mk_comm()
+            log(f"tune-remat {config}/{policy}: starting "
+                f"(backend={jax.default_backend()}, devices={comm.size})")
+            r = _dp_image_bench(model, comm, double_buffering=True,
+                                repeats=max(1, repeats) if on_tpu else 1,
+                                **kw)
+            steps = kw["steps"]
+            ms = 1e3 / (r["images_per_sec"] / (
+                kw["per_chip_batch"] * comm.size))
+            rows[policy] = {
+                "ms_per_step": round(ms, 3),
+                "images_per_sec_per_chip": round(
+                    r["images_per_sec_per_chip"], 2),
+                "final_loss": r["final_loss"],
+                **{k: r[k] for k in ("repeats", "wall_ms_per_step_median",
+                                     "wall_spread_pct") if k in r},
+            }
+            log(f"tune-remat {config}/{policy}: "
+                f"{rows[policy]['ms_per_step']} ms/step")
+        selected = min(rows, key=lambda p: rows[p]["ms_per_step"])
+        sweeps[config] = {
+            "rows": rows,
+            "selected": selected,
+            "selected_ms_per_step": rows[selected]["ms_per_step"],
+        }
+        log(f"tune-remat {config}: selected {selected!r}")
+    return {
+        "schema": "remat_tune/v1",
+        "backend": jax.default_backend(),
+        # CPU-mesh timings exercise the path; the on-chip re-run selects.
+        "smoke": not on_tpu,
+        "fused_norm": True,
+        "policies": list(REMAT_POLICIES),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "configs": sweeps,
+    }
+
+
 # TPU-needing configs first: multi-device configs may reset the process to
 # the virtual CPU mesh, after which the accelerator backend is gone.
 _CONFIGS = [
@@ -585,11 +676,28 @@ def main():
     parser.add_argument("--repeats", type=int, default=5,
                         help="timed windows per accelerator row (median "
                              "reported with min/max spread; default 5)")
+    parser.add_argument("--tune-remat", action="store_true",
+                        help="instead of the five configs, sweep the "
+                             "remat-policy zoo (none/block/norm) over the "
+                             "ResNet configs with the fused norm path and "
+                             "select per-config winners by step time "
+                             "(remat_tune/v1 artifact)")
     args = parser.parse_args()
     global _TPU_REPEATS
     if args.repeats < 1:
         parser.error("--repeats must be >= 1")
     _TPU_REPEATS = args.repeats
+
+    if args.tune_remat:
+        doc = tune_remat(repeats=args.repeats)
+        payload = json.dumps(doc, indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload + "\n")
+            log(f"wrote {args.out}")
+        else:
+            print(payload)
+        return doc
     wanted = args.configs.split(",") if args.configs else [
         name for name, _ in _CONFIGS]
     unknown = set(wanted) - {name for name, _ in _CONFIGS}

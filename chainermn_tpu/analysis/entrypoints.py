@@ -153,6 +153,77 @@ def lint_long_context(rules: Optional[Sequence[str]] = None,
         hlo=hlo, rules=rules, raise_on_error=False)]
 
 
+def _resnet_fused_target(flavor: str = "xla"):
+    """The resnet example's step with the fused normalization path
+    (``ops.FusedBatchNormAct`` at every BN boundary) at toy width — the
+    program the fusednorm probe variant and the remat autotuner time.
+    The Pallas kernels ride inside the shard_map'd loss via their custom
+    VJP; they contain no collectives, so the lintable schedule must stay
+    exactly the flavor's gradient-allreduce plan (census-drift) and the
+    backward must add no unpinned psum (the custom VJP *is* the pin)."""
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet
+    from chainermn_tpu.models.resnet import BasicBlock
+    from chainermn_tpu.ops import FusedBatchNormAct
+    from chainermn_tpu.optimizers import (
+        init_model_state, init_opt_state, make_train_step)
+
+    comm = chainermn_tpu.create_communicator(
+        flavor, intra_size=_NEEDS_INTRA.get(flavor))
+    model = ResNet(stage_sizes=(1,), block_cls=BasicBlock, num_filters=8,
+                   num_classes=10, norm_cls=FusedBatchNormAct)
+    x0 = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x0)
+    params = variables["params"]
+    stats0 = variables["batch_stats"]
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    model_state = init_model_state(comm, stats0)
+    opt_state = init_opt_state(comm, optimizer, params)
+
+    def loss_fn(p, state, batch):
+        x, y = batch
+        logits, mut = model.apply(
+            {"params": p, "batch_stats": state}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, mut["batch_stats"]
+
+    # The gradient probe wants loss(params, *sharded_rest): close over the
+    # (tiny, replicated) initial stats so only the batch is sharded.
+    def probe_loss(p, batch):
+        return loss_fn(p, stats0, batch)[0]
+
+    step = make_train_step(comm, loss_fn, optimizer, with_model_state=True)
+    batch = (jnp.zeros((comm.size * 2, 16, 16, 3), jnp.float32),
+             jnp.zeros((comm.size * 2,), jnp.int32))
+    args = (params, model_state, opt_state, batch)
+    return comm, step, args, probe_loss
+
+
+def lint_resnet_fused(rules: Optional[Sequence[str]] = None,
+                      hlo: bool = True) -> List[LintReport]:
+    """One report for the fused-norm resnet train step (xla flavor).
+    Every rule runs: the desync variants trace the builder twice, the
+    census holds the compiled collectives to the flavor's plan (the
+    Pallas calls must contribute zero), and the gradient probe
+    differentiates through the fused kernels' custom VJP inside the SPMD
+    region — a regrown stats-path psum would land here as
+    unpinned-transpose."""
+    comm, step, args, probe_loss = _resnet_fused_target()
+    params, _, _, batch = args
+    return [lint_step(
+        step, *args,
+        name="examples/resnet_fused[xla]",
+        comm=comm, flavor="xla",
+        loss=probe_loss, loss_args=(params, batch),
+        donate_argnums=(0, 1, 2),
+        variants={"rank0": (step,) + args, "rank1": (step,) + args},
+        census=True, hlo=hlo, rules=rules,
+        raise_on_error=False)]
+
+
 def _serving_decode_target(tp: int = 2):
     """The serving engine's fused prefill+decode forward at toy size,
     tensor-parallel over 2 devices — the jitted program every serving
@@ -207,6 +278,13 @@ ENTRY_POINTS: Dict[str, dict] = {
         "help": "ring-attention sequence-parallel LM step (schedule, "
                 "captured-constant, donation, async rules)",
     },
+    "examples/resnet_fused": {
+        "fn": lint_resnet_fused,
+        "flavors": None,
+        "help": "resnet train step with the fused BN(+ReLU) Pallas "
+                "kernels at every norm boundary (census + gradient probe "
+                "through the custom VJP + desync variants)",
+    },
     "serving/decode": {
         "fn": lint_serving_decode,
         "flavors": None,
@@ -235,4 +313,5 @@ def lint_entry_point(name: str, flavors: Optional[Sequence[str]] = None,
 
 
 __all__ = ["ENTRY_POINTS", "MNIST_FLAVORS", "lint_entry_point",
-           "lint_long_context", "lint_mnist", "lint_serving_decode"]
+           "lint_long_context", "lint_mnist", "lint_resnet_fused",
+           "lint_serving_decode"]

@@ -3,6 +3,7 @@ from chainermn_tpu.models.googlenet import GoogLeNet, GoogLeNetBN
 from chainermn_tpu.models.mlp import MLP
 from chainermn_tpu.models.nin import NIN
 from chainermn_tpu.models.resnet import (
+    REMAT_POLICIES,
     BasicBlock,
     BottleneckBlock,
     ResNet,
@@ -23,6 +24,7 @@ __all__ = [
     "NIN",
     "GoogLeNet",
     "GoogLeNetBN",
+    "REMAT_POLICIES",
     "BasicBlock",
     "BottleneckBlock",
     "ResNet",

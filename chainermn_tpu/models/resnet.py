@@ -24,9 +24,37 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 ModuleDef = Any
+
+#: remat_policy zoo swept by ``benchmarks/run_configs.py --tune-remat``.
+REMAT_POLICIES = ("none", "block", "norm")
+
+
+def _tag(x):
+    """Name conv outputs (= norm inputs) for checkpoint policies.
+
+    Identity unless a ``remat_policy="norm"`` wrapper references the name:
+    that policy saves exactly these boundaries and recomputes the cheap
+    normalize/ReLU tail in the backward pass.
+    """
+    return checkpoint_name(x, "norm_in")
+
+
+def _norm_relu(norm: ModuleDef, x, **kwargs):
+    """norm -> ReLU, fused into one kernel when the norm class supports it.
+
+    ``ops.FusedBatchNormAct`` advertises ``supports_fused_relu`` and takes
+    the ReLU along on the same HBM traversal; any other ``norm_cls`` (the
+    default ``nn.BatchNorm`` included) keeps the reference unfused path.
+    """
+    cls = norm.func if isinstance(norm, partial) else norm
+    if getattr(cls, "supports_fused_relu", False):
+        return norm(fuse_relu=True, **kwargs)(x)
+    return nn.relu(norm(**kwargs)(x))
 
 
 def space_to_depth(x, block: int = 2):
@@ -54,18 +82,19 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
-        y = nn.relu(self.norm()(y))
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = nn.relu(self.norm()(y))
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = _tag(self.conv(self.filters, (1, 1))(x))
+        y = _norm_relu(self.norm, y)
+        y = _tag(self.conv(self.filters, (3, 3), self.strides)(y))
+        y = _norm_relu(self.norm, y)
+        y = _tag(self.conv(self.filters * 4, (1, 1))(y))
         # Zero-init the last BN scale so each block starts as identity —
         # standard large-batch ResNet recipe (matches the reference era's
-        # training tricks for the 32k-batch runs).
+        # training tricks for the 32k-batch runs).  No ReLU here: the
+        # activation lands after the residual add.
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
+            residual = _tag(self.conv(self.filters * 4, (1, 1), self.strides,
+                                      name="conv_proj")(residual))
             residual = self.norm(name="norm_proj")(residual)
         return nn.relu(residual + y)
 
@@ -81,13 +110,13 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
-        y = nn.relu(self.norm()(y))
-        y = self.conv(self.filters, (3, 3))(y)
+        y = _tag(self.conv(self.filters, (3, 3), self.strides)(x))
+        y = _norm_relu(self.norm, y)
+        y = _tag(self.conv(self.filters, (3, 3))(y))
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
+            residual = _tag(self.conv(self.filters, (1, 1), self.strides,
+                                      name="conv_proj")(residual))
             residual = self.norm(name="norm_proj")(residual)
         return nn.relu(residual + y)
 
@@ -107,6 +136,10 @@ class ResNet(nn.Module):
     momentum: float = 0.9
     norm_cls: Any = None  # default nn.BatchNorm; swap for perf probes/variants
     stem: str = "conv7"  # "conv7" (reference) | "s2d" (space-to-depth, TPU)
+    remat_policy: str = "none"  # "none" | "block" (full nn.remat) | "norm"
+    #  ("norm" saves only the checkpoint_name'd conv outputs at norm
+    #   boundaries and recomputes the normalize/ReLU tail in backward —
+    #   swept by benchmarks/run_configs.py --tune-remat)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -132,13 +165,26 @@ class ResNet(nn.Module):
         else:
             raise ValueError(
                 f"unknown stem {self.stem!r}: expected 'conv7' or 's2d'")
-        x = nn.relu(norm(name="bn_init")(x))
+        x = _norm_relu(norm, _tag(x), name="bn_init")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if self.remat_policy == "none":
+            block_cls = self.block_cls
+        elif self.remat_policy == "block":
+            block_cls = nn.remat(self.block_cls)
+        elif self.remat_policy == "norm":
+            block_cls = nn.remat(
+                self.block_cls,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "norm_in"))
+        else:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}: "
+                f"expected one of {REMAT_POLICIES}")
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i,
-                                   conv=conv, norm=norm, strides=strides)(x)
+                x = block_cls(self.num_filters * 2 ** i,
+                              conv=conv, norm=norm, strides=strides)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)

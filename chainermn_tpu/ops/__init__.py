@@ -3,5 +3,20 @@
 
 from chainermn_tpu.ops.cast_scale import cast_scale
 from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.fused_norm import (
+    FusedBatchNormAct,
+    fused_norm,
+    fused_norm_reference,
+    fused_norm_traffic_bytes,
+    resnet_bn_traffic_bytes,
+)
 
-__all__ = ["cast_scale", "flash_attention"]
+__all__ = [
+    "cast_scale",
+    "flash_attention",
+    "fused_norm",
+    "fused_norm_reference",
+    "FusedBatchNormAct",
+    "fused_norm_traffic_bytes",
+    "resnet_bn_traffic_bytes",
+]

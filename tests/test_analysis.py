@@ -508,6 +508,67 @@ def test_clean_sweep_long_context(devices):
     assert "captured-constant" not in rep.skipped
 
 
+def test_clean_sweep_resnet_fused(devices):
+    """Zero error findings on the fused-norm resnet train step: the
+    Pallas kernels inside the shard_map'd loss contribute no
+    collectives, so the census holds the compiled schedule to the xla
+    gradient-allreduce plan, and differentiating through the fused
+    custom VJP adds no unpinned backward psum."""
+    from chainermn_tpu.analysis.entrypoints import lint_resnet_fused
+
+    (rep,) = lint_resnet_fused()
+    assert rep.ok, rep.render_text()
+    for rule_id in ("schedule-desync", "census-drift",
+                    "unpinned-transpose", "captured-constant",
+                    "donation-alias", "async-pair"):
+        assert rule_id not in rep.skipped, (rep.target, rep.skipped)
+
+
+def test_rules_still_fire_through_fused_norm(devices):
+    """The broken-fixture counterpart of the fused clean sweep: routing
+    the body through the fused_norm Pallas kernels must not blind the
+    analyzer.  A seeded rank-divergent collective order around the fused
+    op is still a schedule-desync error, and a raw (unpinned) allreduce
+    of a fused-norm loss still shows the PR 1 gradient-inflation
+    transpose."""
+    from chainermn_tpu.ops import fused_norm
+
+    comm = chainermn_tpu.create_communicator("xla")
+    ax = comm.data_axes
+    scale = jnp.ones((8,), jnp.float32)
+    bias = jnp.zeros((8,), jnp.float32)
+
+    def make_rank_step(rank):
+        def body(x):
+            y, _, _ = fused_norm(x, scale, bias)
+            if rank == 0:
+                return jax.lax.pmax(jax.lax.psum(y, ax), ax)
+            return jax.lax.psum(jax.lax.pmax(y, ax), ax)
+        return shard_map(body, mesh=comm.mesh, in_specs=P(ax),
+                         out_specs=P(ax), check_vma=False)
+
+    x = jnp.ones((comm.size * 2, 8))
+    rep = lint_step(
+        None,
+        variants={f"rank{r}": (make_rank_step(r), x) for r in range(2)},
+        rules=["schedule-desync"], raise_on_error=False)
+    f = _only(rep, "schedule-desync")
+    assert f.severity == "error"
+    assert f.details["index"] == 0  # pallas calls contribute no collectives
+
+    params = {"w": jnp.ones((8, 8))}
+
+    def raw_fused_loss(p, xb):
+        y, _, _ = fused_norm(xb @ p["w"], scale, bias)
+        return comm.allreduce(y.mean(), "mean")
+
+    rep2 = lint_step(None, comm=comm, loss=raw_fused_loss,
+                     loss_args=(params, x),
+                     rules=["unpinned-transpose"], raise_on_error=False)
+    f2 = _only(rep2, "unpinned-transpose")
+    assert f2.details["extra_backward_psums"] >= 1
+
+
 # ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------

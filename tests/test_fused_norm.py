@@ -1,0 +1,442 @@
+"""Fused BatchNorm(+ReLU) Pallas kernels vs. flax's unfused reference.
+
+Off-TPU the kernels run in Pallas interpret mode — the same code the TPU
+compiles.  All kernel math is float32; on float32 activations flax's
+``nn.BatchNorm`` normalizes in float32 too, so forward parity is asserted
+tight (1e-5) and gradient parity at 1e-4 (the custom VJP recomputes x̂
+instead of saving it, which reassociates a few multiplies).  The
+HBM-traffic pins are exact: the pricing function is deterministic and
+backend-independent, and the committed probe artifact plus the perf-gate
+budget must agree with it byte-for-byte.
+"""
+
+import json
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.test_util import check_grads
+
+from chainermn_tpu.ops import (
+    FusedBatchNormAct,
+    fused_norm,
+    fused_norm_reference,
+    fused_norm_traffic_bytes,
+    resnet_bn_traffic_bytes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _x(shape, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape), dtype)
+
+
+def _affine(c, seed=1):
+    rng = np.random.RandomState(seed)
+    scale = jnp.asarray(rng.rand(c) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(c) * 0.2, jnp.float32)
+    return scale, bias
+
+
+def _flax_bn(x, scale, bias, mean, var, *, use_ra, relu, momentum=0.99):
+    """The unfused oracle: ``nn.BatchNorm`` then a separate ReLU."""
+    c = x.shape[-1]
+    variables = {"params": {"scale": scale, "bias": bias},
+                 "batch_stats": {"mean": jnp.asarray(mean, jnp.float32),
+                                 "var": jnp.asarray(var, jnp.float32)}}
+    bn = nn.BatchNorm(use_running_average=use_ra, momentum=momentum)
+    if use_ra:
+        y = bn.apply(variables, x)
+        mutated = variables["batch_stats"]
+    else:
+        y, mut = bn.apply(variables, x, mutable=["batch_stats"])
+        mutated = mut["batch_stats"]
+    return (nn.relu(y) if relu else y), mutated
+
+
+# ---------------------------------------------------------------------------
+# forward parity (train + inference stats, odd channels, zero-init scale)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("c", [16, 13])  # 13: not a lane multiple
+@pytest.mark.parametrize("relu", [True, False])
+def test_forward_matches_flax_train(c, relu):
+    x = _x((8, 5, 5, c))
+    scale, bias = _affine(c)
+    y, mean, var = fused_norm(x, scale, bias, relu=relu)
+    want, _ = _flax_bn(x, scale, bias, jnp.zeros(c), jnp.ones(c),
+                       use_ra=False, relu=relu)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # the returned batch stats are the moments flax computed
+    x2 = np.asarray(x, np.float32).reshape(-1, c)
+    np.testing.assert_allclose(np.asarray(mean), x2.mean(0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), x2.var(0), atol=1e-5)
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_forward_matches_flax_inference_stats(relu):
+    c = 12
+    x = _x((4, 7, 7, c), seed=3)
+    scale, bias = _affine(c)
+    rng = np.random.RandomState(4)
+    mean = jnp.asarray(rng.randn(c) * 0.1, jnp.float32)
+    var = jnp.asarray(rng.rand(c) + 0.3, jnp.float32)
+    y, m, v = fused_norm(x, scale, bias, mean=mean, var=var,
+                         use_running_average=True, relu=relu)
+    want, _ = _flax_bn(x, scale, bias, mean, var, use_ra=True, relu=relu)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # eval mode passes the running stats straight through
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mean))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(var))
+
+
+def test_forward_zero_init_scale():
+    """γ=0 (the resnet norm3 zero-init trick): output is relu(β), and the
+    backward still produces a non-zero dγ so training can leave it."""
+    c = 8
+    x = _x((6, 3, 3, c), seed=5)
+    scale = jnp.zeros((c,), jnp.float32)
+    _, bias = _affine(c)
+    y, _, _ = fused_norm(x, scale, bias, relu=True)
+    want = np.broadcast_to(np.maximum(np.asarray(bias), 0.0), y.shape)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-6)
+
+    dgamma = jax.grad(
+        lambda s: fused_norm(x, s, bias, relu=True)[0].sum())(scale)
+    assert float(jnp.abs(dgamma).max()) > 0.0
+
+
+def test_matches_reference_oracle_exactly():
+    """The pure-XLA oracle reproduces the kernels' own math bit-tight —
+    this is the 'bit-parity or documented tolerance' acceptance check
+    (differences vs flax come only from op reassociation, not logic)."""
+    c = 13
+    x = _x((16, c), seed=6)
+    scale, bias = _affine(c)
+    y, m, v = fused_norm(x, scale, bias, relu=True)
+    yr, mr, vr = fused_norm_reference(x, scale, bias, relu=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(vr), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# backward parity through the custom VJP
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_gradients_match_flax_train(relu):
+    c = 10
+    x = _x((8, 4, 4, c), seed=7)
+    scale, bias = _affine(c)
+
+    def loss_fused(xx, s, b):
+        return (fused_norm(xx, s, b, relu=relu)[0] ** 2).sum()
+
+    def loss_flax(xx, s, b):
+        y, _ = _flax_bn(xx, s, b, jnp.zeros(c), jnp.ones(c),
+                        use_ra=False, relu=relu)
+        return (y ** 2).sum()
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    want = jax.grad(loss_flax, argnums=(0, 1, 2))(x, scale, bias)
+    for g, w, name in zip(got, want, ("x", "scale", "bias")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"grad wrt {name}")
+
+
+def test_gradients_match_flax_inference_stats():
+    """Eval-mode backward: stats are constants, dx = γ·invstd·dz."""
+    c = 6
+    x = _x((5, 3, 3, c), seed=8)
+    scale, bias = _affine(c)
+    mean = jnp.asarray(np.random.RandomState(9).randn(c) * 0.1, jnp.float32)
+    var = jnp.asarray(np.random.RandomState(10).rand(c) + 0.5, jnp.float32)
+
+    def loss_fused(xx, s, b):
+        y, _, _ = fused_norm(xx, s, b, mean=mean, var=var,
+                             use_running_average=True, relu=True)
+        return (y ** 2).sum()
+
+    def loss_flax(xx, s, b):
+        y, _ = _flax_bn(xx, s, b, mean, var, use_ra=True, relu=True)
+        return (y ** 2).sum()
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    want = jax.grad(loss_flax, argnums=(0, 1, 2))(x, scale, bias)
+    for g, w, name in zip(got, want, ("x", "scale", "bias")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"grad wrt {name}")
+
+
+@pytest.mark.slow
+def test_check_grads_through_custom_vjp():
+    """Numerical gradient check of the custom VJP itself (no oracle):
+    relu kinks are dodged by biasing the input away from zero."""
+    c = 5
+    x = _x((4, 3, c), seed=11) + 0.75
+    scale, bias = _affine(c)
+
+    def f(xx, s, b):
+        return fused_norm(xx, s, b, relu=True)[0].sum()
+
+    check_grads(f, (x, scale, bias), order=1, modes=["rev"],
+                rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# module: nn.BatchNorm-compatible tree + momentum update
+# ---------------------------------------------------------------------------
+
+
+def test_module_tree_and_momentum_match_flax():
+    c = 8
+    x = _x((4, 6, 6, c), seed=12)
+    fused = FusedBatchNormAct(use_running_average=False, momentum=0.9)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9)
+    vf = fused.init(jax.random.key(0), x)
+    vr = ref.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(vf) == jax.tree_util.tree_structure(vr)
+    assert jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), vf) \
+        == jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), vr)
+
+    yf, mutf = fused.apply(vf, x, mutable=["batch_stats"])
+    yr, mutr = ref.apply(vr, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yf), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(np.asarray(mutf["batch_stats"][k]),
+                                   np.asarray(mutr["batch_stats"][k]),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_module_fuse_relu_and_eval_mode():
+    c = 8
+    x = _x((4, 6, 6, c), seed=13)
+    mod = FusedBatchNormAct(use_running_average=False, fuse_relu=True)
+    v = mod.init(jax.random.key(0), x)
+    y, _ = mod.apply(v, x, mutable=["batch_stats"])
+    assert float(jnp.min(y)) >= 0.0
+
+    # eval mode: same variables through an inference-configured instance
+    ye = FusedBatchNormAct(use_running_average=True,
+                           fuse_relu=True).apply(v, x)
+    want, _ = _flax_bn(x, v["params"]["scale"], v["params"]["bias"],
+                       v["batch_stats"]["mean"], v["batch_stats"]["var"],
+                       use_ra=True, relu=True)
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_block_rows_and_empty_batch_validation():
+    c = 4
+    x = _x((8, c), seed=14)
+    scale, bias = _affine(c)
+    y, _, _ = fused_norm(x, scale, bias, block_rows=4)  # divides 8: fine
+    assert y.shape == x.shape
+    with pytest.raises(ValueError, match="must divide row count"):
+        fused_norm(x, scale, bias, block_rows=3)
+    with pytest.raises(ValueError, match="empty activation batch"):
+        fused_norm(jnp.zeros((0, c)), scale, bias)
+    with pytest.raises(ValueError, match="needs mean= and var="):
+        fused_norm(x, scale, bias, use_running_average=True)
+
+
+# ---------------------------------------------------------------------------
+# resnet swap-in: the norm_cls seam
+# ---------------------------------------------------------------------------
+
+
+def _toy_resnet(norm_cls=None, remat_policy="none"):
+    from chainermn_tpu.models import ResNet
+    from chainermn_tpu.models.resnet import BasicBlock
+
+    return ResNet(stage_sizes=(1,), block_cls=BasicBlock, num_filters=8,
+                  num_classes=10, norm_cls=norm_cls,
+                  remat_policy=remat_policy)
+
+
+def _canon(tree):
+    """Flatten to {path: leaf} with norm-class and remat renames erased
+    (flax auto-names submodules by class, and nn.remat prefixes the
+    path; RNG folding is per-param-path so shared paths share values)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(kp)
+            .replace("FusedBatchNormAct", "BatchNorm")
+            .replace("CheckpointBasicBlock", "BasicBlock"): v
+            for kp, v in flat}
+
+
+def _resnet_loss(model, batch_stats, x):
+    def f(p):
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"])
+        return (logits ** 2).mean()
+    return f
+
+
+def test_resnet_swap_in_matches_unfused():
+    """Fused norm_cls vs default nn.BatchNorm: same variables modulo the
+    auto-generated norm-module names, same logits and parameter
+    gradients — the seam changes kernels, not math."""
+    x = _x((2, 16, 16, 3), seed=15)
+    ref = _toy_resnet()
+    fused = _toy_resnet(norm_cls=FusedBatchNormAct)
+    v = ref.init(jax.random.key(0), x)
+    vf = fused.init(jax.random.key(0), x)
+    cv, cvf = _canon(v), _canon(vf)
+    assert cv.keys() == cvf.keys()
+    for k in cv:  # conv/dense share RNG fold paths -> identical values
+        np.testing.assert_array_equal(np.asarray(cv[k]), np.asarray(cvf[k]),
+                                      err_msg=k)
+
+    lr, gr = jax.value_and_grad(
+        _resnet_loss(ref, v["batch_stats"], x))(v["params"])
+    lf, gf = jax.value_and_grad(
+        _resnet_loss(fused, vf["batch_stats"], x))(vf["params"])
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5, atol=1e-6)
+    cgr, cgf = _canon(gr), _canon(gf)
+    for k in cgr:
+        np.testing.assert_allclose(np.asarray(cgf[k]), np.asarray(cgr[k]),
+                                   rtol=2e-4, atol=2e-4, err_msg=k)
+
+
+def _rename_for_remat(d):
+    if isinstance(d, dict):
+        return {(k.replace("BasicBlock", "CheckpointBasicBlock")
+                 if k.startswith("BasicBlock") else k):
+                _rename_for_remat(val) for k, val in d.items()}
+    return d
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["block", "norm"])
+def test_resnet_remat_policies_preserve_values(policy):
+    """Each remat_policy is a pure scheduling choice: feeding the
+    remat'd model the 'none' parameters (module paths renamed for the
+    nn.remat prefix) reproduces its logits and grads exactly."""
+    x = _x((2, 16, 16, 3), seed=16)
+    base = _toy_resnet(norm_cls=FusedBatchNormAct, remat_policy="none")
+    rm = _toy_resnet(norm_cls=FusedBatchNormAct, remat_policy=policy)
+    vb = base.init(jax.random.key(0), x)
+    vm = _rename_for_remat(vb)
+
+    lb, gb = jax.value_and_grad(
+        _resnet_loss(base, vb["batch_stats"], x))(vb["params"])
+    lm, gm = jax.value_and_grad(
+        _resnet_loss(rm, vm["batch_stats"], x))(vm["params"])
+    np.testing.assert_allclose(float(lm), float(lb), rtol=1e-6, atol=1e-7)
+    cgb, cgm = _canon(gb), _canon(gm)
+    assert cgb.keys() == cgm.keys()
+    for k in cgb:
+        np.testing.assert_allclose(np.asarray(cgm[k]), np.asarray(cgb[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_unknown_remat_policy_is_an_error():
+    x = jnp.zeros((1, 16, 16, 3))
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        _toy_resnet(remat_policy="everything").init(jax.random.key(0), x)
+
+
+# ---------------------------------------------------------------------------
+# traffic model: the >=2x pin and artifact/budget consistency
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_ratio_pin_relu_boundary():
+    """Acceptance: >=2x fewer modeled HBM bytes per relu'd train
+    boundary (17 vs 8 activation traversals, fwd+bwd)."""
+    t = fused_norm_traffic_bytes((256, 56, 56, 64))
+    assert t["ratio"] >= 2.0, t
+    # and the fused pass table is exactly the four kernels of this module
+    assert [p[0] for p in t["fused"]["passes"]] \
+        == ["fwd_stats", "fwd_apply", "bwd_reduce", "bwd_dx"]
+
+
+def test_traffic_model_variants_are_ordered():
+    shape = (64, 28, 28, 128)
+    full = fused_norm_traffic_bytes(shape)
+    no_relu = fused_norm_traffic_bytes(shape, relu=False)
+    eval_fwd = fused_norm_traffic_bytes(shape, train=False, backward=False)
+    # fusion always wins, by less without the relu traversals (11 vs 8)
+    assert 1.0 < no_relu["ratio"] < full["ratio"]
+    # eval fwd-only: apply-vs-(normalize+scale/shift+relu), still fused-smaller
+    assert eval_fwd["fused"]["total_bytes"] < eval_fwd["unfused"]["total_bytes"]
+    # wider dtype scales activation traversals, not the per-channel vectors
+    f32 = fused_norm_traffic_bytes(shape, dtype=jnp.float32)
+    assert f32["activation_bytes"] == 2 * full["activation_bytes"]
+
+
+def test_resnet_traffic_matches_committed_artifact_and_budget():
+    """The committed probe artifact and the perf-gate budget both carry
+    the number this function computes — drift in any of the three is a
+    failure (that is what makes the gate leg meaningful)."""
+    t = resnet_bn_traffic_bytes(256)
+    assert t["num_boundaries"] == 53  # 1 stem + 16*3 + 4 projections
+    assert t["ratio"] > 1.5
+    assert t["fused_total_bytes"] < t["unfused_total_bytes"]
+
+    with open(os.path.join(REPO, "RESNET_PROBE_r09.json")) as fh:
+        probe = json.load(fh)
+    assert probe["traffic"]["fused_total_bytes"] == t["fused_total_bytes"]
+    assert probe["traffic"]["unfused_total_bytes"] == t["unfused_total_bytes"]
+
+    with open(os.path.join(REPO, "tools", "perf_budgets.json")) as fh:
+        budgets = json.load(fh)
+    (m,) = [m for m in budgets["metrics"]
+            if m["name"] == "resnet_bn_traffic_bytes"]
+    assert m["direction"] == "lower"
+    assert m["budget"] >= t["fused_total_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# the remat autotuner sweep (subprocess, slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tune_remat_sweep_selects_policy_per_config(tmp_path):
+    """`run_configs.py --tune-remat` end to end on the 8-device CPU mesh:
+    sweeps the policy zoo over both resnet configs with the fused path
+    enabled, selects a winner per config by measured step time, and
+    writes the remat_tune/v1 artifact (committed as REMAT_TUNE_r09) —
+    this is also the resnet50_xla-shape e2e run of the acceptance
+    criteria."""
+    import subprocess
+    import sys
+
+    from chainermn_tpu.models import REMAT_POLICIES
+
+    out = tmp_path / "remat_tune.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "run_configs.py"),
+         "--tune-remat", "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                 XLA_FLAGS="--xla_force_host_platform_device_count=8"))
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "remat_tune/v1"
+    assert doc["fused_norm"] is True
+    assert list(doc["policies"]) == list(REMAT_POLICIES)
+    assert set(doc["configs"]) == {"resnet50_xla", "resnet50_hier"}
+    for cfg in doc["configs"].values():
+        assert set(cfg["rows"]) == set(REMAT_POLICIES)
+        assert cfg["selected"] in REMAT_POLICIES
+        swept = {p: row["ms_per_step"] for p, row in cfg["rows"].items()}
+        assert cfg["selected_ms_per_step"] == min(swept.values())
+        assert swept[cfg["selected"]] == cfg["selected_ms_per_step"]

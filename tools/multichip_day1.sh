@@ -115,6 +115,28 @@ run 0 "$OUT/SERVING_$ROUND.json" \
         $PY_TPU benchmarks/bench_serving.py --out '$OUT/SERVING_$ROUND.json' \
         --metrics '$OUT/SERVING_METRICS_$ROUND.jsonl' > /dev/null"
 
+# ---- normalization boundary: fused-kernel probe + remat autotune ------
+# Hardware-free (forced CPU mesh, smoke shapes) so the fused BN(+ReLU)
+# Pallas path and the remat-policy autotuner run on every host; the probe
+# artifact's `traffic` section is the deterministic modeled-HBM-bytes
+# table the resnet_bn_traffic_bytes budget reads (direction: lower), so
+# this leg must land before the PERF_GATE leg.  On a slice, re-run the
+# probe WITHOUT the env override at --batch 256 --image 224 with the full
+# variant set for the measured fusednorm delta (docs/performance.md
+# "normalization boundary").
+run 0 "$OUT/RESNET_PROBE_$ROUND.json" \
+    "resnet probe incl. fusednorm variant on the 8-way CPU mesh (smoke timings; the traffic section feeds the resnet_bn_traffic_bytes budget)" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_resnet_probe.py --batch 8 --image 64 \
+        --steps 2 --variants full,fusednorm \
+        --out '$OUT/RESNET_PROBE_$ROUND.json' 2> /dev/null"
+
+run 0 "$OUT/REMAT_TUNE_$ROUND.json" \
+    "remat-policy autotune: sweep none/block/norm over the resnet configs, pick per-config winners from measured step time (on a slice, re-run without the env override)" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/run_configs.py --tune-remat \
+        --out '$OUT/REMAT_TUNE_$ROUND.json' > /dev/null"
+
 run 1 "$OUT/PERF_GATE_$ROUND.json" \
     "perf gate: fresh bench artifacts vs checked-in budgets (tools/perf_budgets.json; >3% regression on any tracked throughput FAILS this leg)" -- \
     $PY_TPU tools/perf_gate.py --budgets tools/perf_budgets.json \
