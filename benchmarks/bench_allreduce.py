@@ -75,6 +75,17 @@ def main():
                         help="comma-separated payload sizes in KiB for "
                              "--sweep (one rung per autotuner bucket by "
                              "default)")
+    parser.add_argument("--traced", metavar="OUT.json", default=None,
+                        help="instead of the flavor table, A/B the span-"
+                             "tracing overhead: time the same "
+                             "allreduce_grad with the flight recorder "
+                             "off, then on (plan_stage hooks re-traced "
+                             "in), and write tracing_overhead_pct to "
+                             "this JSON — the artifact behind the "
+                             "tracing_overhead_pct perf budget")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="A/B repeats for --traced (min of each arm "
+                             "is the reported time)")
     parser.add_argument("--dcn-gbps", type=float, default=None,
                         help="model the inter (DCN) hops of each swept "
                              "plan at this link bandwidth: adds "
@@ -120,6 +131,8 @@ def main():
         return _census(args)
     if args.sweep:
         return _sweep(args)
+    if args.traced:
+        return _traced(args)
 
     if args.scaling:
         counts = [c for c in (2 ** k for k in range(1, 12))
@@ -235,6 +248,91 @@ def _time_spmd(comm, body, stacked, iters, warmup):
             jax.block_until_ready(out)
     fence(out)
     return (time.perf_counter() - t0) / iters
+
+
+def _traced(args):
+    """--traced: measure what the per-stage span hooks cost.
+
+    Times the first requested flavor's ``allreduce_grad`` twice with the
+    exact :func:`_time_spmd` discipline — once with observability off
+    (the zero-callback program) and once with a flight recorder
+    installed, which makes ``execute_plan`` re-trace the plan with its
+    ``plan_stage_begin``/``_end`` debug callbacks in.  Each arm runs
+    ``--repeats`` times interleaved and reports its MIN (standard
+    microbenchmark noise floor).  The written artifact
+    (``tracing_overhead/v1``) carries ``tracing_overhead_pct``, the
+    number ``tools/perf_budgets.json`` holds under 3%.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.observability import flight_recorder as _flight
+
+    flavor = args.communicators.split(",")[0]
+    kwargs = {}
+    if args.intra_size is not None:
+        kwargs["intra_size"] = args.intra_size
+    comm = chainermn_tpu.create_communicator(flavor, **kwargs)
+    n = comm.size
+    n_elems = int(args.mb * (1 << 20) / np.dtype(args.dtype).itemsize)
+    stacked = jnp.tile(
+        jnp.arange(n, dtype=args.dtype).reshape(n, 1), (1, n_elems))
+
+    def make_body():
+        # a FRESH closure per arm: jit caches by function identity, so
+        # each arm traces its own program (with/without the hooks)
+        def body(g):
+            return comm.allreduce_grad(g)
+        return body
+
+    def run_arm():
+        body = make_body()
+        out = comm.run_spmd(body, stacked)  # compile + correctness
+        np.testing.assert_allclose(
+            np.asarray(out[0, :3]), (n - 1) / 2.0, rtol=1e-2)
+        return _time_spmd(comm, body, stacked, args.iters, args.warmup)
+
+    had_recorder = _flight.get_flight_recorder() is not None
+    times = {"off": [], "on": []}
+    events_recorded = 0
+    try:
+        for _ in range(max(int(args.repeats), 1)):
+            if not had_recorder:
+                _flight.reset_flight_recorder()
+            times["off"].append(run_arm())
+            fr = _flight.install_flight_recorder()
+            before = len(fr.snapshot())
+            times["on"].append(run_arm())
+            events_recorded = len(fr.snapshot()) - before
+    finally:
+        if not had_recorder:
+            _flight.reset_flight_recorder()
+    if events_recorded <= 0:
+        print("--traced: the traced arm recorded no plan_stage events — "
+              "overhead A/B is meaningless", file=sys.stderr)
+        return 1
+    t_off, t_on = min(times["off"]), min(times["on"])
+    pct = (t_on - t_off) / t_off * 100.0
+    doc = {"schema": "tracing_overhead/v1",
+           "backend": jax.default_backend(),
+           "n_devices": n,
+           "communicator": flavor,
+           "payload_mib": args.mb,
+           "iters": args.iters,
+           "repeats": args.repeats,
+           "time_ms_off": round(t_off * 1e3, 4),
+           "time_ms_on": round(t_on * 1e3, 4),
+           "events_per_traced_run": events_recorded,
+           "tracing_overhead_pct": round(pct, 3),
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(args.traced, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"tracing_overhead_pct": doc["tracing_overhead_pct"],
+                      "time_ms_off": doc["time_ms_off"],
+                      "time_ms_on": doc["time_ms_on"]}), flush=True)
+    return doc
 
 
 def _sweep(args):

@@ -62,6 +62,16 @@ def run_policy(policy, model, params, trace, args):
     eng.run_until_idle()
     eng.completions.clear()
 
+    # Span seam (--metrics runs have observability on, so the engine
+    # recorded serving_step/serving_forward spans): remember where the
+    # ring stands so the summary below covers only the timed window.
+    from chainermn_tpu.observability import flight_recorder as _flight
+    fr = _flight.get_flight_recorder()
+    seq0 = -1
+    if fr is not None:
+        evs = fr.snapshot()
+        seq0 = evs[-1]["seq"] if evs else -1
+
     t0 = time.perf_counter()
     pending = list(trace)
     steps = 0
@@ -88,8 +98,16 @@ def run_policy(policy, model, params, trace, args):
     for c in comps:
         per_token.extend(np.diff(c.token_times))
     pct = lambda a, q: float(np.percentile(a, q)) if len(a) else None
+    spans = None
+    if fr is not None:
+        try:
+            from chainermn_tpu.observability import span_summary
+            spans = span_summary(fr.events_since(seq0), rank=0, k=3)
+        except Exception:  # noqa: BLE001 — supplementary only
+            spans = None
     return {
         "policy": policy,
+        **({"span_summary": spans} if spans else {}),
         "requests": len(comps),
         "generated_tokens": n_tokens,
         "steps": steps,

@@ -46,10 +46,28 @@ from chainermn_tpu.observability.instrument import (
     instrument_communicator,
 )
 from chainermn_tpu.observability.straggler import (
+    AttributionWatch,
     StepTelemetry,
     StragglerDetector,
     straggler_report,
     summarize_durations,
+)
+from chainermn_tpu.observability.spans import (
+    PlanObs,
+    Span,
+    build_step_trees,
+    get_plan_obs,
+)
+from chainermn_tpu.observability.attribution import (
+    BUCKETS,
+    attribute_step,
+    attribution_report,
+    clock_handshake,
+    critical_path,
+    merge_ranks,
+    offset_from_samples,
+    span_summary,
+    to_trace_events,
 )
 from chainermn_tpu.observability.flight_recorder import (
     FlightRecorder,
@@ -66,32 +84,46 @@ from chainermn_tpu.observability.watchdog import (
 )
 
 __all__ = [
+    "AttributionWatch",
+    "BUCKETS",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "InstrumentedCommunicator",
     "MetricsRegistry",
+    "PlanObs",
+    "Span",
     "StepTelemetry",
     "StragglerDetector",
     "Watchdog",
     "WatchdogConfig",
     "append_jsonl",
     "atomic_write_json",
+    "attribute_step",
+    "attribution_report",
+    "build_step_trees",
+    "clock_handshake",
+    "critical_path",
     "disable",
     "enable",
     "enabled",
     "get_flight_recorder",
+    "get_plan_obs",
     "get_registry",
     "identify_desync",
     "install_flight_recorder",
     "instrument_communicator",
+    "merge_ranks",
+    "offset_from_samples",
     "prometheus_text",
     "read_jsonl",
     "reset_flight_recorder",
+    "span_summary",
     "start_watchdog",
     "straggler_report",
     "summarize_durations",
+    "to_trace_events",
     "watchdog_thread_count",
     "write_prometheus",
     "write_snapshot_jsonl",

@@ -102,6 +102,11 @@ class FlightRecorder:
         self._buf: List[Optional[dict]] = [None] * self.capacity
         self._pos = 0
         self._event_seq = 0
+        # ring-overflow accounting: every record() that overwrites a
+        # still-live slot bumps this, so dumps can say how many events
+        # the ring LOST instead of silently presenting a truncated
+        # history as complete (satellite of ISSUE 10).
+        self.dropped_events = 0
         self._lock = threading.Lock()
         self._span_seq = 0
         # per-op collective sequence numbers (key: op name) — the
@@ -118,13 +123,24 @@ class FlightRecorder:
 
     # ---- core recording ----------------------------------------------------
     def record(self, kind: str, **fields) -> dict:
-        ev = {"kind": kind, "ts": time.time(), **fields}
+        # both clocks: ``ts`` (wall) for cross-rank merging after offset
+        # correction, ``mono`` (monotonic) for drift-immune local ages
+        ev = {"kind": kind, "ts": time.time(), "mono": time.monotonic(),
+              **fields}
         with self._lock:
             ev["seq"] = self._event_seq
             self._event_seq += 1
+            if self._buf[self._pos] is not None:
+                self.dropped_events += 1
             self._buf[self._pos] = ev
             self._pos = (self._pos + 1) % self.capacity
         return ev
+
+    def events_since(self, seq: int) -> List[dict]:
+        """Events with ``seq`` strictly greater than ``seq``, oldest
+        first — the incremental slice online consumers (the attribution
+        watch) pull per emit without re-walking the whole ring."""
+        return [ev for ev in self.snapshot() if ev.get("seq", -1) > seq]
 
     def span_begin(self, kind: str, op: str, **fields) -> int:
         """Open a tracked span (collective / p2p / transport recv /
@@ -140,7 +156,8 @@ class FlightRecorder:
         ev = self.record(f"{kind}_begin", op=op, op_seq=op_seq, **fields)
         with self._lock:
             self._open[token] = {"kind": kind, "op": op, "op_seq": op_seq,
-                                 "ts": ev["ts"], **fields}
+                                 "ts": ev["ts"], "mono": ev["mono"],
+                                 **fields}
         return token
 
     def span_end(self, token: int, **fields) -> None:
@@ -150,7 +167,7 @@ class FlightRecorder:
             return
         self.record(f"{open_rec['kind']}_end", op=open_rec["op"],
                     op_seq=open_rec["op_seq"],
-                    dur_s=time.time() - open_rec["ts"], **fields)
+                    dur_s=time.monotonic() - open_rec["mono"], **fields)
         with self._lock:
             prev = self._last_completed.get(open_rec["op"], 0)
             if open_rec["op_seq"] > prev:
@@ -187,9 +204,16 @@ class FlightRecorder:
         return tail + head
 
     def open_spans(self, now: Optional[float] = None) -> List[dict]:
+        """Currently-open spans with ``age_s``.  Ages come from the
+        MONOTONIC clock (``now`` is only the wall-clock fallback for
+        legacy records without a ``mono`` stamp) — an NTP step or
+        cross-host drift can no longer mint phantom stragglers or
+        phantom collective timeouts."""
         now = time.time() if now is None else now
+        mono_now = time.monotonic()
         with self._lock:
-            out = [dict(rec, age_s=now - rec["ts"])
+            out = [dict(rec, age_s=(mono_now - rec["mono"])
+                        if "mono" in rec else (now - rec["ts"]))
                    for rec in self._open.values()]
         return sorted(out, key=lambda r: r["ts"])
 
@@ -210,8 +234,11 @@ class FlightRecorder:
             last = dict(self._last_completed)
             steps = self.steps
             event_seq = self._event_seq
+            dropped = self.dropped_events
         return {"last_completed": last, "open": self.open_spans(),
-                "steps": steps, "event_seq": event_seq, "ts": time.time()}
+                "steps": steps, "event_seq": event_seq,
+                "dropped_events": dropped, "ts": time.time(),
+                "mono": time.monotonic()}
 
     # ---- the dump ----------------------------------------------------------
     def dump(self, out_dir: str = ".", rank: int = 0, reason: str = "",
@@ -226,6 +253,9 @@ class FlightRecorder:
             "rank": int(rank),
             "ts": time.time(),
             "reason": reason,
+            # events the ring overwrote before this dump — a nonzero
+            # count means the timeline below is missing its oldest part
+            "dropped_events": int(self.dropped_events),
             "collective_state": local_state,
             "events": self.snapshot(),
             "threads": thread_stacks(),

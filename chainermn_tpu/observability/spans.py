@@ -1,0 +1,342 @@
+"""Span-tree reconstruction — from flight-recorder events to a per-step
+tree of timed regions.
+
+The flight recorder (ISSUE 2) stores *edges*: ``<kind>_begin`` /
+``<kind>_end`` pairs for tracked spans, plain ``phase`` / ``step``
+progress markers from the updater, ``fsdp_{gather,scatter}_{begin,end}``
+bucket edges from the bucketed FSDP step, and (new here) per-stage
+``plan_stage_{begin,end}`` edges from the plan compiler.  This module
+pairs those edges back into :class:`Span` intervals and nests them by
+containment into one tree per train step::
+
+    step #12 [0.034s]
+      ├─ phase:data_load [0.002s]
+      ├─ phase:host_put  [0.001s]
+      ├─ phase:dispatch  [0.009s]
+      │    └─ collective allreduce_grad (trace-time)
+      └─ phase:device_block [0.022s]
+           ├─ plan_stage hier:0 reduce-scatter intra (ici)
+           ├─ plan_stage hier:1 all-reduce inter (dcn)
+           │    └─ compute compress:plan:inter
+           └─ plan_stage hier:2 all-gather intra (ici)
+
+:mod:`chainermn_tpu.observability.attribution` consumes these trees for
+the cross-rank merge, bucket decomposition, critical path, and the
+Perfetto export; ``tools/obs_report.py --attribution`` renders them.
+
+The second half of the module is :class:`PlanObs` /
+:func:`get_plan_obs` — the compiler-side hook that EMITS the per-stage
+edges, following the ``compression/observe.py`` pattern exactly: bound
+once per trace, ``None`` while observability is off (zero callbacks in
+a disabled program), delivered from device-side ``jax.debug.callback``\\ s
+gated to one representative device per controller so every process's
+recorder carries its own stage stream.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: pairing slack for float timestamps (well under any real span)
+_EPS = 1e-9
+
+
+@dataclass
+class Span:
+    """One timed region on one rank.  ``meta`` keeps the raw event
+    fields (op_seq, plan, stage, scope, link, nbytes, iteration, ...)."""
+
+    name: str
+    kind: str
+    rank: int
+    t0: float
+    t1: float
+    meta: dict = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+
+    @property
+    def dur_s(self) -> float:
+        return max(self.t1 - self.t0, 0.0)
+
+    def walk(self):
+        """Yield self and every descendant (pre-order)."""
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "rank": self.rank,
+            "t0": self.t0, "t1": self.t1, "dur_s": self.dur_s,
+            "meta": dict(self.meta),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+# ---------------------------------------------------------------------------
+# edge pairing
+# ---------------------------------------------------------------------------
+
+def _span_key(ev: dict) -> Optional[tuple]:
+    """Pairing key for a ``*_begin``/``*_end`` edge event, or ``None``
+    for non-edge events.  Tracked spans pair on (kind, op, op_seq); the
+    plan-stage lane pairs on (plan, stage); the FSDP lane on
+    (leg, bucket) — each mirrors how its emitter sequences edges."""
+    k = ev.get("kind", "")
+    if k.startswith("plan_stage_"):
+        return ("plan_stage", ev.get("plan"), ev.get("stage"))
+    if k.startswith("fsdp_gather_") or k.startswith("fsdp_scatter_"):
+        leg = k.split("_")[1]
+        return ("fsdp", leg, ev.get("bucket"))
+    if k.endswith("_begin") or k.endswith("_end"):
+        base = k.rsplit("_", 1)[0]
+        return (base, ev.get("op"), ev.get("op_seq"))
+    return None
+
+
+def _span_from_pair(begin: dict, end: dict, rank: int) -> Span:
+    k = begin.get("kind", "")
+    if k.startswith("plan_stage_"):
+        name = (f"plan_stage {begin.get('plan', '?')}:"
+                f"{begin.get('stage', '?')} {begin.get('op', '?')} "
+                f"{begin.get('scope', '?')}")
+        kind = "plan_stage"
+    elif k.startswith("fsdp_"):
+        leg = k.split("_")[1]
+        name = f"fsdp_{leg} b{begin.get('bucket', '?')}"
+        kind = "fsdp"
+    else:
+        kind = k.rsplit("_", 1)[0]
+        name = f"{kind} {begin.get('op', '?')}"
+    meta = {kk: vv for kk, vv in begin.items()
+            if kk not in ("kind", "ts", "seq", "mono")}
+    for kk, vv in end.items():
+        if kk not in ("kind", "ts", "seq", "mono") and kk not in meta:
+            meta[kk] = vv
+    return Span(name=name, kind=kind, rank=rank,
+                t0=begin.get("ts", 0.0), t1=end.get("ts", 0.0), meta=meta)
+
+
+def pair_events(events: List[dict], rank: int = 0) -> List[Span]:
+    """Pair begin/end edges into flat (un-nested) spans, oldest first.
+    Unmatched begins (still-open spans, or begins whose end was
+    overwritten by ring wraparound) are dropped — attribution only
+    counts completed regions."""
+    open_edges: Dict[tuple, dict] = {}
+    out: List[Span] = []
+    for ev in events:
+        key = _span_key(ev)
+        if key is None:
+            continue
+        k = ev.get("kind", "")
+        if k.endswith("_begin"):
+            open_edges[key] = ev
+        else:
+            begin = open_edges.pop(key, None)
+            if begin is not None:
+                out.append(_span_from_pair(begin, ev, rank))
+    out.sort(key=lambda s: (s.t0, -s.t1))
+    return out
+
+
+def step_windows(events: List[dict], rank: int = 0) -> List[Span]:
+    """Step root spans.  ``step`` events are END-stamped (the updater
+    records ``dur_s`` at step completion), so each window is
+    ``[ts - dur_s, ts]``.  Serving runs have no ``step`` events — their
+    ``serving serving_step`` spans become the roots instead."""
+    out = []
+    for ev in events:
+        if ev.get("kind") == "step":
+            t1 = ev.get("ts", 0.0)
+            dur = float(ev.get("dur_s", 0.0))
+            out.append(Span(name=f"step #{ev.get('iteration', '?')}",
+                            kind="step", rank=rank, t0=t1 - dur, t1=t1,
+                            meta={"iteration": ev.get("iteration"),
+                                  "dur_s": dur}))
+    if not out:
+        for sp in pair_events(events, rank=rank):
+            if sp.kind == "serving" and sp.meta.get("op") == "serving_step":
+                out.append(Span(name=f"step #{sp.meta.get('step', '?')}",
+                                kind="step", rank=rank, t0=sp.t0, t1=sp.t1,
+                                meta=dict(sp.meta,
+                                          iteration=sp.meta.get("step"))))
+    out.sort(key=lambda s: s.t0)
+    return out
+
+
+def phase_spans(events: List[dict], steps: List[Span],
+                rank: int = 0) -> List[Span]:
+    """Turn ``phase`` markers (recorded at phase START) into spans: each
+    phase runs until the next phase marker of the same iteration, else
+    to its enclosing step window's end."""
+    markers = [ev for ev in events if ev.get("kind") == "phase"]
+    out: List[Span] = []
+    for i, ev in enumerate(markers):
+        t0 = ev.get("ts", 0.0)
+        nxt = markers[i + 1] if i + 1 < len(markers) else None
+        t1 = None
+        if nxt is not None and nxt.get("iteration") == ev.get("iteration"):
+            t1 = nxt.get("ts", 0.0)
+        if t1 is None:
+            for st in steps:
+                if st.t0 - _EPS <= t0 <= st.t1 + _EPS:
+                    t1 = st.t1
+                    break
+        if t1 is None:
+            t1 = nxt.get("ts", t0) if nxt is not None else t0
+        out.append(Span(name=f"phase:{ev.get('phase', '?')}", kind="phase",
+                        rank=rank, t0=t0, t1=max(t1, t0),
+                        meta={"phase": ev.get("phase"),
+                              "iteration": ev.get("iteration")}))
+    return out
+
+
+def _nest(parent: Span, spans: List[Span]) -> None:
+    """Nest ``spans`` (pre-sorted by (t0, -t1)) under ``parent`` by
+    interval containment — the classic stack sweep."""
+    stack = [parent]
+    for s in spans:
+        while len(stack) > 1 and not (
+                s.t0 >= stack[-1].t0 - _EPS and s.t1 <= stack[-1].t1 + _EPS):
+            stack.pop()
+        stack[-1].children.append(s)
+        stack.append(s)
+
+
+def build_step_trees(events: List[dict], rank: int = 0,
+                     offset: float = 0.0) -> List[Span]:
+    """The tree builder: step roots, phases + paired spans nested inside
+    by containment.  ``offset`` (seconds) is added to every timestamp —
+    the attribution merge passes each rank's clock-handshake offset so
+    all trees land in the reference rank's timebase."""
+    has_step_events = any(ev.get("kind") == "step" for ev in events)
+    steps = step_windows(events, rank=rank)
+    leaves = phase_spans(events, steps, rank=rank)
+    # In the serving fallback the serving_step spans ARE the roots —
+    # keep them out of the leaf set so a root never nests under itself.
+    leaves.extend(
+        sp for sp in pair_events(events, rank=rank)
+        if has_step_events or not (sp.kind == "serving"
+                                   and sp.meta.get("op") == "serving_step"))
+    leaves.sort(key=lambda s: (s.t0, -s.t1))
+    for st in steps:
+        inside = [s for s in leaves
+                  if st.t0 - _EPS <= 0.5 * (s.t0 + s.t1) <= st.t1 + _EPS]
+        _nest(st, inside)
+    if offset:
+        for st in steps:
+            for sp in st.walk():
+                sp.t0 += offset
+                sp.t1 += offset
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# PlanObs — the compiler-side per-stage span hooks
+# ---------------------------------------------------------------------------
+
+class PlanObs:
+    """Begin/end edges for each emitted plan stage, delivered from
+    device-side ``jax.debug.callback``\\ s inserted by
+    ``planner/compiler._run_stages_flat``.
+
+    Gating: the callback fires on every device of the SPMD region;
+    ``rep_rank`` picks ONE representative global device index per
+    controller (``get_plan_obs`` derives it from the communicator's
+    rank/host layout), so each process's flight recorder carries exactly
+    one stage stream — unlike the compression lane, which keeps a single
+    global stream on rank 0, the attribution merge needs per-controller
+    events to see cross-host skew.
+
+    Metric family (labels ``plan``/``stage``/``op``/``scope``/``link``):
+
+    * ``plan_stage_seconds`` (histogram) — host-observed latency between
+      a stage's begin and end callbacks;
+    * ``plan_stage_bytes`` (counter) — wire bytes the stage moved
+      (``_stage_wire_elem_bytes`` pricing, compression included).
+    """
+
+    def __init__(self, flight, registry, rep_rank: int = 0,
+                 rep_stride: int = 1):
+        self.flight = flight
+        self.registry = registry
+        self.rep_rank = int(rep_rank)
+        # devices per controller: the compiler's device-side gate fires
+        # the callback only where global_idx % rep_stride == 0 (one shard
+        # per controller — the same shards rep_rank picks host-side)
+        self.rep_stride = max(int(rep_stride), 1)
+        self._begin: dict = {}
+        if registry is not None:
+            self._seconds = registry.histogram(
+                "plan_stage_seconds",
+                "host-observed per-stage latency of an executed plan")
+            self._bytes = registry.counter(
+                "plan_stage_bytes",
+                "wire bytes moved per executed plan stage")
+
+    def edge(self, edge: str, plan: str, stage: int, op: str, scope: str,
+             link: str, nbytes: int) -> None:
+        now = time.perf_counter()
+        key = (plan, stage)
+        if self.flight is not None:
+            self.flight.record(f"plan_stage_{edge}", plan=plan, stage=stage,
+                               op=op, scope=scope, link=link, nbytes=nbytes)
+        if self.registry is not None:
+            labels = {"plan": plan, "stage": str(stage), "op": op,
+                      "scope": scope, "link": link}
+            if edge == "begin":
+                self._begin[key] = now
+            else:
+                t0 = self._begin.pop(key, None)
+                if t0 is not None:
+                    self._seconds.observe(now - t0, **labels)
+                self._bytes.inc(nbytes, **labels)
+
+    def make_callback(self, edge: str, plan: str, stage: int, op: str,
+                      scope: str, link: str, nbytes: int):
+        """A rank-gated debug callback for one stage edge.  Called with
+        ``(rank_idx, _dep)`` — ``_dep`` pins when the device reaches the
+        edge (the stage's input on begin, its output on end)."""
+
+        def cb(rank_idx, _dep):
+            if int(rank_idx) == self.rep_rank:
+                self.edge(edge, plan, stage, op, scope, link, nbytes)
+        return cb
+
+
+def get_plan_obs(comm=None) -> Optional[PlanObs]:
+    """The build-time hook: ``None`` while observability is off (a
+    disabled ``execute_plan`` trace carries no callbacks at all).  With
+    a communicator, the representative device is this controller's
+    first local device under the contiguous device→process mesh layout
+    (``rank * (size // host_size)``)."""
+    from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.observability import registry as _registry
+
+    fr = _flight.get_flight_recorder()
+    reg = _registry.get_registry() if _registry.enabled() else None
+    if fr is None and reg is None:
+        return None
+    rep, stride = 0, 1
+    if comm is not None:
+        try:
+            size = int(getattr(comm, "size", 1) or 1)
+            hosts = max(int(getattr(comm, "host_size", 1) or 1), 1)
+            stride = max(size // hosts, 1)
+            rep = int(getattr(comm, "rank", 0) or 0) * stride
+        except Exception:
+            rep, stride = 0, 1
+    return PlanObs(fr, reg, rep_rank=rep, rep_stride=stride)
+
+
+__all__ = [
+    "PlanObs",
+    "Span",
+    "build_step_trees",
+    "get_plan_obs",
+    "pair_events",
+    "phase_spans",
+    "step_windows",
+]

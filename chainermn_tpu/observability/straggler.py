@@ -67,6 +67,10 @@ def straggler_report(summaries: List[dict], threshold: float = 1.5) -> dict:
                     "mean_s": s["mean_s"],
                     "ratio_vs_median": s["mean_s"] / median,
                 })
+    compute = []
+    for s in summaries:
+        compute.extend(s.get("compute_open", ()))
+    compute.sort(key=lambda x: -float(x.get("age_s", 0.0)))
     return {
         "kind": "straggler_report",
         "n_ranks": len(summaries),
@@ -74,6 +78,7 @@ def straggler_report(summaries: List[dict], threshold: float = 1.5) -> dict:
         "threshold": threshold,
         "ranks": summaries,
         "stragglers": stragglers,
+        "compute_stragglers": compute,
     }
 
 
@@ -82,23 +87,75 @@ class StragglerDetector:
 
     ``comm=None`` (or a single-host world) degrades to a local-only
     report — same schema, one rank.
+
+    ``clock`` is an optional control-plane clock-handshake result
+    (:func:`~chainermn_tpu.observability.attribution.clock_handshake`,
+    or one peer entry of ``Watchdog.clock_sync``): when present, the
+    summaries carry offset-corrected global timestamps.  Compute
+    straggler AGES never touch wall clocks at all — they come from each
+    rank's monotonic clock via ``FlightRecorder.open_spans`` — so
+    cross-host drift cannot mint phantom stragglers; the offset only
+    places them on the shared timeline.
     """
 
     def __init__(self, comm=None, threshold: float = 1.5,
-                 window_size: int = 512):
+                 window_size: int = 512, clock: Optional[dict] = None):
         if threshold <= 1.0:
             raise ValueError(f"threshold must be > 1, got {threshold}")
         self._comm = comm
         self.threshold = float(threshold)
         self._durations = collections.deque(maxlen=int(window_size))
+        self.clock = dict(clock) if clock else None
 
     def record(self, seconds: float) -> None:
         self._durations.append(float(seconds))
+
+    def sync_clock(self, rounds: int = 8) -> dict:
+        """Run the object-plane clock handshake (COLLECTIVE — every rank
+        at the same point) and keep the result for timestamp
+        correction."""
+        from chainermn_tpu.observability.attribution import clock_handshake
+
+        self.clock = clock_handshake(self._comm, rounds=rounds)
+        return self.clock
+
+    def compute_stragglers(self, min_age_s: float = 0.0) -> List[dict]:
+        """THIS rank's currently-open ``kind="compute"`` spans (e.g. a
+        wedged quantizer), tagged with monotonic-clock ages and — when a
+        clock handshake ran — offset-corrected global start stamps."""
+        from chainermn_tpu.observability import flight_recorder as _flight
+
+        fr = _flight.get_flight_recorder()
+        if fr is None:
+            return []
+        rank = self._comm.rank if self._comm is not None else 0
+        offset = float((self.clock or {}).get("offset_s", 0.0))
+        out = []
+        for rec in fr.open_spans():
+            if rec.get("kind") != "compute":
+                continue
+            age = float(rec.get("age_s", 0.0))
+            if age < min_age_s:
+                continue
+            entry = {"op": rec.get("op"), "rank": rank, "age_s": age,
+                     "clock": "monotonic"}
+            if self.clock is not None:
+                entry["t0_global"] = float(rec.get("ts", 0.0)) + offset
+            out.append(entry)
+        out.sort(key=lambda x: -x["age_s"])
+        return out
 
     def local_summary(self) -> dict:
         s = summarize_durations(self._durations)
         s["rank"] = self._comm.rank if self._comm is not None else 0
         s["ts"] = time.time()
+        s["mono_ts"] = time.monotonic()
+        if self.clock is not None:
+            s["clock_offset_s"] = float(self.clock.get("offset_s", 0.0))
+            s["ts_global"] = s["ts"] + s["clock_offset_s"]
+        open_compute = self.compute_stragglers()
+        if open_compute:
+            s["compute_open"] = open_compute
         return s
 
     def report(self, reset: bool = False) -> dict:
@@ -117,6 +174,123 @@ class StragglerDetector:
         if reset:
             self._durations.clear()
         return straggler_report(summaries, threshold=self.threshold)
+
+
+class AttributionWatch:
+    """Online per-bucket regression detection over step attributions.
+
+    Feed it one :func:`~chainermn_tpu.observability.attribution.
+    attribute_step` result per completed step (``MetricsReport`` builds
+    them from the flight recorder's incremental event slice).  Per
+    bucket it keeps a rolling median baseline and:
+
+    * sets ``attribution_bucket_seconds{bucket=...}`` gauges every step;
+    * on ``value > factor x baseline`` (and above ``min_seconds``, with
+      at least ``min_baseline`` steps banked) bumps
+      ``attribution_regressions_total{bucket=...}``, records an
+      ``attribution_regression`` flight event, and — when
+      ``profile_dir`` is set — snapshots the flagged step with
+      ``jax.profiler``: the capture starts at detection and stops after
+      the NEXT observed step, so the trace brackets one regressed
+      iteration.
+    """
+
+    def __init__(self, registry=None, flight=None, window: int = 64,
+                 factor: float = 2.0, min_seconds: float = 1e-3,
+                 min_baseline: int = 8,
+                 profile_dir: Optional[str] = None):
+        from chainermn_tpu.observability import attribution as _attr
+        from chainermn_tpu.observability import flight_recorder as _flight
+        from chainermn_tpu.observability import registry as _registry
+
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.buckets = _attr.BUCKETS
+        self.factor = float(factor)
+        self.min_seconds = float(min_seconds)
+        self.min_baseline = int(min_baseline)
+        self.profile_dir = profile_dir
+        self._flight = flight if flight is not None \
+            else _flight.get_flight_recorder()
+        reg = registry if registry is not None else \
+            (_registry.get_registry() if _registry.enabled() else None)
+        self._reg = reg
+        self._windows = {b: collections.deque(maxlen=int(window))
+                         for b in self.buckets}
+        self._profiling = False
+        self.regressions: List[dict] = []
+        if reg is not None:
+            self._gauge = reg.gauge(
+                "attribution_bucket_seconds",
+                "per-step step-time attribution bucket (compute / "
+                "ici_comm / dcn_comm / host_input / checkpoint / stall)")
+            self._sum_frac = reg.gauge(
+                "attribution_sum_frac",
+                "sum of attribution buckets over measured step time "
+                "(should stay within tolerance of 1.0)")
+            self._regs = reg.counter(
+                "attribution_regressions_total",
+                "bucket regressions flagged by the rolling-baseline "
+                "attribution watch")
+
+    def _baseline(self, bucket: str) -> Optional[float]:
+        w = sorted(self._windows[bucket])
+        if len(w) < self.min_baseline:
+            return None
+        n = len(w)
+        return w[n // 2] if n % 2 else 0.5 * (w[n // 2 - 1] + w[n // 2])
+
+    def _profile_start(self, iteration) -> None:
+        if self.profile_dir is None or self._profiling:
+            return
+        try:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        except Exception:
+            self._profiling = False
+
+    def _profile_stop(self) -> None:
+        if not self._profiling:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._profiling = False
+
+    def observe(self, attribution: dict) -> List[dict]:
+        """Bank one step's attribution; returns the regressions flagged
+        on THIS step (empty list when healthy)."""
+        buckets = attribution.get("buckets", {})
+        iteration = attribution.get("iteration")
+        # a capture started by the previous step's regression ends here,
+        # having bracketed the flagged iteration
+        self._profile_stop()
+        if self._reg is not None:
+            for b in self.buckets:
+                self._gauge.set(float(buckets.get(b, 0.0)), bucket=b)
+            self._sum_frac.set(float(attribution.get("sum_frac", 1.0)))
+        flagged = []
+        for b in self.buckets:
+            val = float(buckets.get(b, 0.0))
+            base = self._baseline(b)
+            if (base is not None and val > self.factor * base
+                    and val - base > self.min_seconds):
+                reg = {"bucket": b, "value_s": val, "baseline_s": base,
+                       "ratio": val / base if base > 0 else float("inf"),
+                       "iteration": iteration}
+                flagged.append(reg)
+                if self._reg is not None:
+                    self._regs.inc(1, bucket=b)
+                if self._flight is not None:
+                    self._flight.record("attribution_regression", **reg)
+            self._windows[b].append(val)
+        if flagged:
+            self.regressions.extend(flagged)
+            self._profile_start(iteration)
+        return flagged
 
 
 class StepTelemetry:

@@ -139,6 +139,12 @@ class Watchdog:
         self._incidents: set = set()
         self._peer_states: Dict[int, dict] = {}
         self._hb_seen: Dict[int, float] = {}
+        # clock handshake state: per-peer (t_send, t_peer_wall, t_recv)
+        # NTP samples collected by the listen threads, and the offsets
+        # clock_sync() last derived from them (embedded in every dump so
+        # attribution can merge cross-host timelines drift-corrected)
+        self._clock_samples: Dict[int, List[tuple]] = {}
+        self.clock_offsets: Dict[int, dict] = {}
         self._started_at = time.time()
         self.dump_paths: List[str] = []
         self.incidents: List[dict] = []
@@ -244,6 +250,60 @@ class Watchdog:
                               incident=msg.get("incident"))
             elif kind == "state_reply":
                 self._peer_states[src] = msg.get("state", {})
+            elif kind == "clock_probe":
+                self._send(src, {"kind": "clock_reply", "rank": self.rank,
+                                 "probe": msg.get("probe"),
+                                 "wall": time.time()})
+            elif kind == "clock_reply":
+                probe = msg.get("probe") or {}
+                t_send = probe.get("t_send")
+                if t_send is not None:
+                    self._clock_samples.setdefault(src, []).append(
+                        (float(t_send), float(msg.get("wall", 0.0)),
+                         time.time()))
+
+    # ---- the control-plane clock handshake ---------------------------------
+    def clock_sync(self, rounds: int = 4,
+                   window_s: Optional[float] = None) -> Dict[int, dict]:
+        """Estimate per-peer wall-clock offsets over the watchdog's
+        control-plane tag: each round sends a ``clock_probe`` stamped
+        with the local send time, peers echo it back in a
+        ``clock_reply`` carrying their wall clock, and the listen
+        threads bank the ``(t_send, t_peer, t_recv)`` samples.  The NTP
+        midpoint of the min-RTT sample per peer
+        (:func:`~chainermn_tpu.observability.attribution.
+        offset_from_samples`) gives ``local_ts + offset_s`` ≈ the same
+        instant on that peer's clock — what the attribution merge and
+        the straggler detector use instead of trusting raw wall clocks
+        across hosts.  Best-effort: unreachable peers simply stay
+        absent from the result."""
+        from chainermn_tpu.observability.attribution import \
+            offset_from_samples
+
+        if not self._peers:
+            self.clock_offsets = {}
+            return {}
+        if window_s is None:
+            window_s = self._cfg.collect_window_s
+        rounds = max(int(rounds), 1)
+        for _ in range(rounds):
+            self._send_all({"kind": "clock_probe", "rank": self.rank,
+                            "probe": {"t_send": time.time()}})
+            time.sleep(min(max(window_s, 0.05) / rounds, 0.25))
+        deadline = time.time() + window_s
+        while (time.time() < deadline and not self._closed.is_set()
+               and any(not self._clock_samples.get(p)
+                       for p in self._peers)):
+            time.sleep(0.02)
+        out: Dict[int, dict] = {}
+        for p in self._peers:
+            samples = self._clock_samples.get(p)
+            if samples:
+                off, rtt = offset_from_samples(samples)
+                out[p] = {"offset_s": off, "rtt_s": rtt,
+                          "samples": len(samples)}
+        self.clock_offsets = out
+        return out
 
     # ---- messaging (best-effort: a dead peer must not kill the dump) -------
     def _send(self, dest: int, msg: dict):
@@ -281,11 +341,22 @@ class Watchdog:
                    and len(self._peer_states) < len(self._peers)
                    and not self._closed.is_set()):
                 time.sleep(0.05)
+        if self._peers and not self.clock_offsets:
+            try:  # best-effort: the dump must not hang on a dead peer
+                self.clock_sync(
+                    rounds=2,
+                    window_s=min(1.0, self._cfg.collect_window_s))
+            except Exception:
+                pass
+        extra = {"incident": incident, "world_size": self.size,
+                 "watchdog": self._cfg.as_dict()}
+        if self.clock_offsets:
+            extra["clock"] = {"rank": self.rank,
+                              "offsets": {str(r): dict(d) for r, d in
+                                          self.clock_offsets.items()}}
         path = self._rec.dump(
             out_dir=self._cfg.out_dir, rank=self.rank, reason=reason,
-            peers=dict(self._peer_states) or None,
-            extra={"incident": incident, "world_size": self.size,
-                   "watchdog": self._cfg.as_dict()})
+            peers=dict(self._peer_states) or None, extra=extra)
         self.dump_paths.append(path)
         self.incidents.append({"incident": incident, "reason": reason,
                                "path": path, "ts": time.time()})

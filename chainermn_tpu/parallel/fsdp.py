@@ -579,8 +579,10 @@ class _FsdpObs:
         jax debug-callback thread on the gated rank only)."""
         now = time.perf_counter()
         if self.flight is not None:
+            # link tags the hop for step-time attribution: the bucketed
+            # per-parameter collectives ride the fast interconnect
             self.flight.record(f"fsdp_{leg}_{edge}", bucket=bucket,
-                               nbytes=nbytes)
+                               nbytes=nbytes, link="ici")
         if self.registry is not None:
             key = (leg, bucket)
             if edge == "begin":

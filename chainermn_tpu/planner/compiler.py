@@ -207,12 +207,48 @@ def _compressed_psum(st: Stage, idx: int, axes, world: int, buf, state,
     return out[:m].astype(orig_dtype), state
 
 
+def _stage_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
+                st: Stage, buf, edge: str, wire_bytes: Optional[float] = None):
+    """Insert one per-stage span edge (``plan_stage_begin``/``_end``)
+    as a device-side debug callback, data-dependent on one element of
+    ``buf`` so it fires when the device reaches this point, gated inside
+    :class:`~chainermn_tpu.observability.spans.PlanObs` to one
+    representative device per controller.  ``link`` prices the hop the
+    same way :func:`plan_dcn_bytes` does: ``intra`` rides ICI, ``inter``
+    and ``all`` cross the DCN boundary.  ``wire_bytes`` overrides the
+    payload size (the leaf-packing path prices the whole tree, not the
+    representative leaf the callback rides on)."""
+    if pobs is None:
+        return
+    ridx = lax.axis_index(_axis_arg(topology.scope_axes("all")))
+    if wire_bytes is None:
+        wire_bytes = _stage_wire_elem_bytes(
+            plan, st, float(buf.shape[0]), jnp.dtype(buf.dtype).itemsize)
+    link = "ici" if st.scope == "intra" else "dcn"
+    cb = pobs.make_callback(edge, plan.name, i, st.op, st.scope, link,
+                            int(round(wire_bytes)))
+    # Device-side gate: only one shard per controller (global index a
+    # multiple of the per-controller device count) pays the host
+    # round-trip — the SAME predicate on every controller, so the SPMD
+    # programs stay identical; the host-side rep_rank check remains the
+    # backstop.
+    stride = max(int(getattr(pobs, "rep_stride", 1)), 1)
+    jax.lax.cond(
+        ridx % stride == 0,
+        lambda r, d: jax.debug.callback(cb, r, d),
+        lambda r, d: None,
+        ridx, buf.reshape(-1)[0])
+
+
 def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
-                     states: Optional[Dict] = None, obs=None):
+                     states: Optional[Dict] = None, obs=None, pobs=None):
     """Apply the stage chain to one flat buffer.  ``states`` maps stage
     index -> per-hop CompressionState for quantizing stages; returns
     ``(buf, new_states)`` (``new_states`` empty when nothing is
-    stateful)."""
+    stateful).  ``pobs`` (a :class:`spans.PlanObs`, or ``None`` when
+    observability is off) brackets every emitted stage with
+    ``plan_stage_begin``/``_end`` flight events — the attribution
+    subsystem's ICI-vs-DCN ground truth."""
     from chainermn_tpu.communicators import _packing
 
     states = dict(states or {})
@@ -222,6 +258,7 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
         axes = topology.scope_axes(st.scope)
         if not axes:
             continue
+        _stage_hook(pobs, plan, topology, i, st, buf, "begin")
         quant = _quantizer_for(st)
         if quant is not None:
             world = topology.scope_size(st.scope)
@@ -233,8 +270,7 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
                 state = quant.init_state(int(buf.shape[0]), world, hop=i)
             buf, new_states[i] = _compressed_psum(
                 st, i, axes, world, buf, state, obs)
-            continue
-        if st.op == "all-reduce":
+        elif st.op == "all-reduce":
             if st.compression is not None:
                 # identity compressor: exactly the wire-dtype cast path
                 comp = st.compressor()
@@ -294,35 +330,72 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
                              lambda b: lax.ppermute(b, axes[0], perm))
         else:  # pragma: no cover — ir validation rejects unknown ops
             raise PlanError(f"unknown stage op {st.op!r}")
+        _stage_hook(pobs, plan, topology, i, st, buf, "end")
     return buf, new_states
+
+
+def _leaf_stage_op(plan: Plan, topology: PlanTopology, st: Stage, leaf):
+    """Apply ONE stage to one leaf (leaf-mode ops only: all-reduce/
+    multicast/p2p — ir.validate).  Degenerate scopes pass through."""
+    axes = topology.scope_axes(st.scope)
+    if not axes:
+        return leaf
+    if st.op == "all-reduce":
+        return _with_wire(leaf, st.wire_dtype,
+                          lambda v: lax.psum(v, _axis_arg(axes)))
+    if st.op == "multicast":
+        idx = lax.axis_index(_axis_arg(axes))
+
+        def bcast(v):
+            masked = jnp.where(idx == st.root, v, jnp.zeros_like(v))
+            return lax.psum(masked, _axis_arg(axes))
+
+        return _with_wire(leaf, st.wire_dtype, bcast)
+    if st.op == "p2p":
+        n = topology.scope_size(st.scope)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return _with_wire(leaf, st.wire_dtype,
+                          lambda v: lax.ppermute(v, axes[0], perm))
+    # pragma: no cover — leaf validation rejects sharding ops
+    raise PlanError(f"stage op {st.op!r} is not legal under leaf packing")
 
 
 def _run_stages_leaf(plan: Plan, topology: PlanTopology, leaf):
     """Leaf-mode chain: all-reduce/multicast/p2p only (ir.validate)."""
     for st in plan.stages:
-        axes = topology.scope_axes(st.scope)
-        if not axes:
-            continue
-        if st.op == "all-reduce":
-            leaf = _with_wire(leaf, st.wire_dtype,
-                              lambda v: lax.psum(v, _axis_arg(axes)))
-        elif st.op == "multicast":
-            idx = lax.axis_index(_axis_arg(axes))
-
-            def bcast(v):
-                masked = jnp.where(idx == st.root, v, jnp.zeros_like(v))
-                return lax.psum(masked, _axis_arg(axes))
-
-            leaf = _with_wire(leaf, st.wire_dtype, bcast)
-        elif st.op == "p2p":
-            n = topology.scope_size(st.scope)
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            leaf = _with_wire(leaf, st.wire_dtype,
-                              lambda v: lax.ppermute(v, axes[0], perm))
-        else:  # pragma: no cover — leaf validation rejects sharding ops
-            raise PlanError(
-                f"stage op {st.op!r} is not legal under leaf packing")
+        leaf = _leaf_stage_op(plan, topology, st, leaf)
     return leaf
+
+
+def _run_stages_leaf_traced(plan: Plan, topology: PlanTopology, grads,
+                            n: int, pobs):
+    """Leaf packing with per-stage span hooks.  Runs stage-outer /
+    leaf-inner — per leaf the stage chain is identical to
+    :func:`_run_stages_leaf` (leaves are independent), but the loop
+    order lets one begin/end pair bracket each stage for the WHOLE tree.
+    The callback rides the largest leaf (the stage's dominant cost);
+    ``wire_bytes`` prices every leaf on that stage's wire."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    sized = [l for l in leaves if getattr(l, "size", 0)]
+    if not sized:
+        return jax.tree.map(
+            lambda g: _run_stages_leaf(plan, topology, g) / n, grads)
+    for i, st in enumerate(plan.stages):
+        if not topology.scope_axes(st.scope):
+            continue
+        wire_bytes = sum(
+            _stage_wire_elem_bytes(plan, st, float(l.size),
+                                   jnp.dtype(l.dtype).itemsize)
+            for l in sized)
+        dep = max(sized, key=lambda l: l.size)
+        _stage_hook(pobs, plan, topology, i, st, dep, "begin",
+                    wire_bytes=wire_bytes)
+        leaves = [_leaf_stage_op(plan, topology, st, l) for l in leaves]
+        sized = [l for l in leaves if getattr(l, "size", 0)]
+        dep = max(sized, key=lambda l: l.size)
+        _stage_hook(pobs, plan, topology, i, st, dep, "end",
+                    wire_bytes=wire_bytes)
+    return jax.tree_util.tree_unflatten(treedef, [l / n for l in leaves])
 
 
 def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
@@ -347,11 +420,15 @@ def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
     topology = comm.plan_topology()
     n = topology.size
     has_quant = bool(plan_compressed_hops(plan, topology))
+    from chainermn_tpu.observability import spans as _spans
+    pobs = _spans.get_plan_obs(comm)
     if plan.packing == "leaf":
         if states is not None:
             raise PlanError(
                 f"plan {plan.name!r}: leaf packing carries no per-hop "
                 "compression state")
+        if pobs is not None:
+            return _run_stages_leaf_traced(plan, topology, grads, n, pobs)
         return jax.tree.map(
             lambda g: _run_stages_leaf(plan, topology, g) / n, grads)
     # Quantizing plans exchange ONE float32 buffer (the quantizer's
@@ -370,7 +447,7 @@ def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
     out_buffers = []
     for b in buffers:
         b, st_out = _run_stages_flat(plan, topology, b, states=states,
-                                     obs=obs)
+                                     obs=obs, pobs=pobs)
         new_states.update(st_out)
         out_buffers.append(b)
     result = _packing.unpack(out_buffers, meta, scale=1.0 / n)

@@ -243,7 +243,13 @@ class InferenceEngine:
         sched = self.scheduler
         if self.plane.size > 1:
             plan = sched.build_plan() if self.plane.rank == 0 else None
+            btok = None
+            if self._fr is not None:
+                btok = self._fr.span_begin("object", "serving_plan_bcast",
+                                           step=self._step_idx)
             plan = self.plane.bcast_obj(plan, root=0)
+            if self._fr is not None:
+                self._fr.span_end(btok)
         else:
             plan = sched.build_plan()
         tok = None
@@ -260,6 +266,18 @@ class InferenceEngine:
         emitted: list = []
         last_logits = None
         if ran:
+            ftok = None
+            if self._fr is not None:
+                # the decode/prefill forward sub-span: fwd dispatch plus
+                # the sampled-token sync — the device-bound slice of a
+                # serving step the attribution lane separates from
+                # scheduling/bcast time
+                n_arr = np.asarray(n_new)
+                ftok = self._fr.span_begin(
+                    "serving", "serving_forward", step=self._step_idx,
+                    n_new=int(n_arr.sum()),
+                    decode_slots=int((n_arr == 1).sum()),
+                    prefill_slots=int((n_arr > 1).sum()))
             sampled_d, logits_d, self._ck, self._cv = self._fwd(
                 self._params, self._ck, self._cv,
                 jnp.asarray(batch["page_table"]),
@@ -268,6 +286,8 @@ class InferenceEngine:
             sampled = np.asarray(sampled_d)   # device sync point
             if self.cfg.keep_logits:
                 last_logits = np.asarray(logits_d)
+            if self._fr is not None:
+                self._fr.span_end(ftok)
             emitted = sched.note_sampled(n_new, sampled)
             now = time.perf_counter()
             for rid, _tok, _n in emitted:

@@ -111,7 +111,10 @@ class MetricsReport:
                  straggler_every: int = 1, straggler_threshold: float = 1.5,
                  prometheus: Optional[str] = None, registry=None,
                  tokens_per_example: Optional[int] = None,
-                 watchdog: Optional[bool] = None):
+                 watchdog: Optional[bool] = None,
+                 attribution: bool = True,
+                 attribution_factor: float = 2.0,
+                 profile_dir: Optional[str] = None):
         if straggler_every < 1:
             raise ValueError(f"straggler_every must be >= 1, got "
                              f"{straggler_every}")
@@ -126,6 +129,14 @@ class MetricsReport:
         # to the metrics JSONL); None defers to CHAINERMN_TPU_WATCHDOG.
         self._want_watchdog = watchdog
         self._watchdog = None
+        # attribution=True (and the flight recorder on) runs the online
+        # per-bucket regression watch over each completed step's span
+        # tree; profile_dir arms the jax.profiler capture hook that
+        # snapshots a flagged step.
+        self._want_attribution = attribution
+        self._attribution_factor = attribution_factor
+        self._profile_dir = profile_dir
+        self._attr = None
         self._active = False
 
     def initialize(self, trainer):
@@ -149,6 +160,16 @@ class MetricsReport:
                      **{p: 0.0 for p in self._tele.PHASES}}
         self._t_last_emit = time.perf_counter()
         self._emits = 0
+        self._fr = obs.get_flight_recorder()
+        self._attr_seq = -1
+        self._last_attr = None
+        if self._want_attribution and self._fr is not None:
+            from chainermn_tpu.observability.straggler import \
+                AttributionWatch
+            self._attr = AttributionWatch(
+                registry=reg, flight=self._fr,
+                factor=self._attribution_factor,
+                profile_dir=self._profile_dir)
         want_wd = self._want_watchdog
         if want_wd is None:
             want_wd = os.environ.get("CHAINERMN_TPU_WATCHDOG", "") \
@@ -159,6 +180,26 @@ class MetricsReport:
             self._watchdog = start_watchdog(
                 control_plane=getattr(comm, "_cp", None),
                 out_dir=trainer.out)
+
+    def _observe_attribution(self) -> None:
+        """Feed every newly-completed step's span tree to the
+        attribution watch (incremental: only events past the last
+        consumed step are re-read from the ring)."""
+        if self._attr is None:
+            return
+        evs = self._fr.events_since(self._attr_seq)
+        step_evs = [e for e in evs if e.get("kind") == "step"]
+        if not step_evs:
+            return
+        last_seq = step_evs[-1].get("seq", self._attr_seq)
+        window = [e for e in evs if e.get("seq", 0) <= last_seq]
+        from chainermn_tpu.observability import attribution as _attribution
+        from chainermn_tpu.observability import spans as _spans
+        for tree in _spans.build_step_trees(
+                window, rank=getattr(self._comm, "rank", 0)):
+            self._last_attr = _attribution.attribute_step(tree)
+            self._attr.observe(self._last_attr)
+        self._attr_seq = last_seq
 
     def _emit_record(self, trainer) -> dict:
         import time as _t
@@ -203,6 +244,7 @@ class MetricsReport:
             for p in self._tele.PHASES:
                 w[p] += last[f"{p}_s"]
             self._tele.last = None
+        self._observe_attribution()
         if not _trigger_fires(self._emit, trainer.updater):
             return
         record = self._emit_record(trainer)
@@ -221,6 +263,11 @@ class MetricsReport:
             straggler = dict(straggler,
                              iteration=trainer.updater.iteration)
             append_jsonl(self._path, straggler)
+        if self._last_attr is not None:
+            append_jsonl(self._path, dict(self._last_attr,
+                                          kind="step_attribution",
+                                          ts=time.time()))
+            self._last_attr = None
         if self._prometheus:
             write_prometheus(self._prometheus, self._reg.snapshot())
 

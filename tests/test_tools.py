@@ -59,7 +59,9 @@ def test_multichip_day1_dry_run():
                  "combiner/barrier split", "five BASELINE configs",
                  "ring attention", "multi-controller",
                  "cmn-lint static preflight", "perf gate",
-                 "collective-planner autotune gate"):
+                 "collective-planner autotune gate",
+                 "step-time attribution smoke",
+                 "span-tracing overhead A/B"):
         assert step in out, f"runbook lost its '{step}' step:\n{out}"
     assert out.count("DRY_RUN: not executed") >= 9, out
     assert "artifact:" in out
@@ -217,3 +219,101 @@ def test_obs_report_flight_merges_golden_dumps(tmp_path):
          "--flight", str(tmp_path)],
         env=env, capture_output=True, text=True, timeout=120)
     assert r3.returncode == 1
+
+
+def test_obs_report_attribution_metrics_section(tmp_path):
+    """--section attribution renders the per-emit bucket table from
+    step_attribution records plus the regression-watch counters."""
+    path = tmp_path / "metrics.jsonl"
+    records = [
+        {"kind": "step_attribution", "iteration": 10, "rank": 0,
+         "step_s": 0.02,
+         "buckets": {"compute": 0.010, "ici_comm": 0.002,
+                     "dcn_comm": 0.004, "host_input": 0.003,
+                     "checkpoint": 0.0, "stall": 0.001},
+         "sum_frac": 1.0},
+        {"kind": "metric", "name": "attribution_regressions_total",
+         "type": "counter", "labels": {"bucket": "dcn_comm"}, "value": 2,
+         "ts": 1.0},
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--section", "attribution", str(path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "step-time attribution" in r.stdout
+    assert "it10" in r.stdout and "100.0%" in r.stdout
+    assert "attribution regressions" in r.stdout
+    assert "dcn_comm" in r.stdout
+
+
+def test_obs_report_flight_attribution_golden(tmp_path):
+    """--flight --attribution on the checked-in attribution goldens
+    (tests/data/attr_flight_*.json — 2 ranks x 2 steps, rank 1 owns a
+    2x-slower DCN hop): per-rank bucket rows must sum to 100%, and the
+    critical path must name a (rank, span) pair that descends into the
+    slow plan stage."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    data = os.path.join(REPO, "tests", "data")
+    dumps = [os.path.join(data, "attr_flight_0.json"),
+             os.path.join(data, "attr_flight_1.json")]
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight"] + dumps + ["--attribution"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "step-time attribution" in out
+    assert out.count("100.0%") >= 4          # 2 steps x 2 ranks, exact sums
+    assert "critical path" in out
+    assert "plan_stage hierarchical:1" in out  # descends into the DCN stage
+    assert "critical path of the slowest step" in out
+
+    # --trace exports Chrome/Perfetto trace-event JSON that round-trips
+    trace = tmp_path / "trace.json"
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight"] + dumps + ["--trace", str(trace)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    doc = json.load(open(trace))
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "no complete events in the exported trace"
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+
+    # --trace without --flight is a usage error, not a silent no-op
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--trace", str(tmp_path / "x.json"), dumps[0]],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r3.returncode != 0
+    assert "--flight" in (r3.stderr + r3.stdout)
+
+
+def test_obs_report_flight_ring_overflow_messaging(tmp_path):
+    """A dump whose recorder overwrote ring slots must surface the loss:
+    the summary grows a dropped column and the timeline leads with a
+    RING OVERFLOW banner; --events truncation is reported with the
+    recovery knob."""
+    data = os.path.join(REPO, "tests", "data")
+    src = json.load(open(os.path.join(data, "attr_flight_0.json")))
+    src["dropped_events"] = 7
+    p = tmp_path / "flight_0.json"
+    json.dump(src, open(p, "w"))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--flight", str(p), "--events", "5"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "dropped" in out                      # summary column
+    assert "RING OVERFLOW: rank 0 lost 7 event(s)" in out
+    assert "CHAINERMN_TPU_FLIGHT_CAPACITY" in out
+    assert "older event(s) truncated" in out     # --events window notice
+    assert "raise --events" in out

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Attribution smoke — the ISSUE-10 acceptance check, runnable anywhere.
+
+Spawns a 2-controller CPU-mesh world (4 devices each), trains a small
+MNIST-shaped MLP with the flight recorder + step telemetry on (so every
+layer of the span model is exercised: step -> phase -> plan_stage hooks
+from the collective planner), runs the cross-rank clock handshake, and
+dumps ``flight_<rank>.json`` per rank.  The parent then rebuilds the
+span trees exactly the way ``tools/obs_report.py --flight --attribution``
+does and asserts the ISSUE acceptance criteria:
+
+* per-rank bucket decomposition sums to the measured step time within
+  5% on every step;
+* the cross-rank critical path names a concrete ``(rank, span)`` pair;
+* the Chrome/Perfetto trace-event export round-trips through
+  ``json.loads`` with well-formed complete ("X") events.
+
+Writes an ``attribution_smoke/v1`` JSON artifact and exits nonzero on
+any violation — the multichip_day1.sh ATTRIBUTION leg runs this.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from chainermn_tpu.utils.proc_world import spawn_world  # noqa: E402
+
+TOLERANCE = 0.05  # buckets must sum to the measured step time within 5%
+
+_WORKER = r"""
+import json, os, sys
+os.environ["CHAINERMN_TPU_OBSERVABILITY"] = "1"
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import chainermn_tpu
+
+chainermn_tpu.init_distributed(local_device_count=4)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from chainermn_tpu.datasets import TupleDataset
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP
+from chainermn_tpu.observability import clock_handshake, get_flight_recorder
+from chainermn_tpu.observability.straggler import StepTelemetry
+from chainermn_tpu.optimizers import init_opt_state, make_train_step
+from chainermn_tpu.training import StandardUpdater
+
+steps = int(os.environ.get("ATTR_SMOKE_STEPS", "6"))
+out_dir = os.environ["ATTR_SMOKE_OUT"]
+
+fr = get_flight_recorder()
+assert fr is not None, "observability switch did not take"
+
+comm = chainermn_tpu.create_communicator("hierarchical")
+assert comm.host_size == 2, comm.host_size
+
+model = MLP(n_units=32, n_out=10)
+params = model.init(jax.random.key(0), jnp.zeros((1, 784)))["params"]
+params = comm.bcast_data(params)
+optimizer = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
+opt_state = init_opt_state(comm, optimizer, params)
+
+def loss_fn(p, batch):
+    x, y = batch
+    logits = model.apply({"params": p}, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+step = make_train_step(comm, loss_fn, optimizer)
+
+rng = np.random.RandomState(7 + comm.rank)
+x = rng.randn(256, 784).astype(np.float32)
+y = (rng.rand(256) * 10).astype(np.int32)
+it = SerialIterator(TupleDataset(x, y), batch_size=64, shuffle=False)
+
+updater = StandardUpdater(it, step, params, opt_state, comm)
+updater.telemetry = StepTelemetry(comm=comm)  # device_block phase too
+for _ in range(steps):
+    updater.update()
+
+hs = clock_handshake(comm)
+path = fr.dump(out_dir, rank=comm.rank, reason="attribution_smoke",
+               extra={"clock": {"rank": comm.rank, "offsets": {"0": hs}}})
+
+med = fr.trailing_step_median()
+print("RESULT " + json.dumps({
+    "rank": comm.rank, "steps": steps, "dump": path,
+    "offset_s": hs["offset_s"], "rtt_s": hs["rtt_s"],
+    "median_step_s": med,
+    "dropped_events": fr.dropped_events,
+}))
+"""
+
+
+def run_world(steps: int, dump_dir: str, timeout: float = 600.0) -> dict:
+    os.environ["ATTR_SMOKE_STEPS"] = str(steps)
+    os.environ["ATTR_SMOKE_OUT"] = dump_dir
+    try:
+        return spawn_world(_WORKER, n_procs=2, local_devices=4,
+                           timeout=timeout)
+    finally:
+        os.environ.pop("ATTR_SMOKE_STEPS", None)
+        os.environ.pop("ATTR_SMOKE_OUT", None)
+
+
+def check_dumps(dumps, checks):
+    """Run the acceptance asserts over loaded flight dumps; appends
+    ``{"name", "ok", ...}`` rows to ``checks`` and returns the
+    attribution report + trace document."""
+    from chainermn_tpu.observability import attribution as _attr
+
+    events_by_rank = {int(d["rank"]): d.get("events", []) for d in dumps}
+    offsets = {}
+    for d in dumps:
+        own = ((d.get("clock") or {}).get("offsets") or {}).get("0")
+        if own is not None:
+            offsets[int(d["rank"])] = float(own.get("offset_s", 0.0))
+    rep = _attr.attribution_report(events_by_rank, offsets=offsets)
+
+    # 1. every (step, rank): buckets sum to the measured step time <= 5%
+    worst = 0.0
+    n_attr = 0
+    for st in rep["steps"]:
+        for r, a in st["ranks"].items():
+            n_attr += 1
+            worst = max(worst, abs(a["sum_frac"] - 1.0))
+    checks.append({"name": "buckets_sum_to_step_time",
+                   "ok": n_attr > 0 and worst <= TOLERANCE,
+                   "attributed_steps": n_attr,
+                   "worst_sum_frac_err": worst, "tolerance": TOLERANCE})
+
+    # 2. the critical path names a concrete (rank, span) pair
+    cp = next((st["critical_path"] for st in rep["steps"]
+               if st.get("critical_path")), [])
+    named = bool(cp) and all("rank" in e and e.get("name") for e in cp)
+    checks.append({"name": "critical_path_names_rank_and_span",
+                   "ok": named,
+                   "path": [(e.get("rank"), e.get("name")) for e in cp]})
+
+    # 3. trace-event JSON round-trips with well-formed "X" events
+    trees = _attr.merge_ranks(events_by_rank, offsets)
+    trace = json.loads(json.dumps(_attr.to_trace_events(trees)))
+    xs = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    wellformed = bool(xs) and all(
+        isinstance(e.get("ts"), (int, float)) and e.get("dur", 0) >= 0
+        and e.get("name") and "pid" in e and "tid" in e for e in xs)
+    checks.append({"name": "trace_json_round_trips", "ok": wellformed,
+                   "n_complete_events": len(xs)})
+    return rep, trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=6,
+                    help="train steps per controller (default 6)")
+    ap.add_argument("--out", default="ATTRIBUTION.json", metavar="PATH",
+                    help="artifact path (attribution_smoke/v1 JSON)")
+    ap.add_argument("--dump-dir", default=None, metavar="DIR",
+                    help="where workers drop flight_<rank>.json "
+                         "(default: a temp dir)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    dump_dir = args.dump_dir or tempfile.mkdtemp(prefix="attr_smoke_")
+    os.makedirs(dump_dir, exist_ok=True)
+    results = run_world(args.steps, dump_dir, timeout=args.timeout)
+
+    dumps = []
+    for r in sorted(results):
+        with open(results[r]["dump"]) as f:
+            dumps.append(json.load(f))
+
+    checks = []
+    rep, trace = check_dumps(dumps, checks)
+    ok = all(c["ok"] for c in checks)
+
+    doc = {
+        "kind": "attribution_smoke/v1",
+        "ok": ok,
+        "n_ranks": len(dumps),
+        "steps_per_rank": args.steps,
+        "checks": checks,
+        "offsets": rep.get("offsets", {}),
+        "summary": rep.get("summary", {}),
+        "n_trace_events": len(trace.get("traceEvents", [])),
+        "worker_results": {str(r): results[r] for r in sorted(results)},
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+
+    for c in checks:
+        print(f"  [{'ok' if c['ok'] else 'FAIL'}] {c['name']}")
+    print(f"attribution smoke: {'OK' if ok else 'FAILED'} "
+          f"({len(dumps)} rank(s), artifact {args.out})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

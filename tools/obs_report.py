@@ -41,6 +41,13 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+# The attribution/trace lanes rebuild span trees with the library code
+# (chainermn_tpu.observability.attribution); every other lane is
+# stdlib-only and keeps working without the package importable.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
@@ -288,6 +295,47 @@ def serving_section(records: List[dict]) -> str:
     return "\n\n".join(parts)
 
 
+_BUCKET_COLS = ("compute", "ici_comm", "dcn_comm", "host_input",
+                "checkpoint", "stall")
+
+
+def _attr_row(label: str, a: dict) -> List[str]:
+    b = a.get("buckets", {})
+    return ([label, _fmt_s(a.get("step_s"))]
+            + [_fmt_s(b.get(k, 0.0)) for k in _BUCKET_COLS]
+            + [f"{a.get('sum_frac', 0.0) * 100:.1f}%"])
+
+
+_ATTR_HEADERS = (["step", "total"] + list(_BUCKET_COLS) + ["sum"])
+
+
+def attribution_section(records: List[dict]) -> str:
+    """Attribution lane (metrics mode): the ``step_attribution`` records
+    the MetricsReport extension appends per emit — one bucket
+    decomposition row each — plus the online watch's ``attribution_*``
+    regression counters."""
+    reps = [r for r in records if r.get("kind") == "step_attribution"]
+    parts = []
+    if reps:
+        rows = [_attr_row(f"it{r.get('iteration', '?')}", r) for r in reps]
+        parts.append("step-time attribution (per emit, latest step)\n"
+                     + _table(list(_ATTR_HEADERS), rows))
+    latest = _latest_metric_lines(records)
+    regs = []
+    for (name, labels), r in latest.items():
+        if name == "attribution_regressions_total":
+            regs.append([dict(labels).get("bucket", "?"),
+                         f"{int(r.get('value', 0))}"])
+    if regs:
+        parts.append("attribution regressions (rolling-baseline watch)\n"
+                     + _table(["bucket", "count"], sorted(regs)))
+    if not parts:
+        return ("attribution: no step_attribution records or "
+                "attribution_* metrics (enable observability and the "
+                "MetricsReport extension)")
+    return "\n\n".join(parts)
+
+
 SECTIONS = {
     "collectives": collectives_section,
     "steps": steps_section,
@@ -295,6 +343,7 @@ SECTIONS = {
     "bench": bench_section,
     "compression": compression_section,
     "serving": serving_section,
+    "attribution": attribution_section,
 }
 
 
@@ -346,6 +395,15 @@ def _flight_analysis(dumps: List[dict]) -> dict:
                         "n_ranks": len(dumps)}
 
 
+def _dump_dropped(d: dict) -> int:
+    """Ring-overflow count of one dump (events the recorder overwrote
+    before dumping — older dumps without the counter read as 0)."""
+    v = d.get("dropped_events")
+    if v is None:
+        v = d.get("collective_state", {}).get("dropped_events", 0)
+    return int(v or 0)
+
+
 def flight_summary_section(dumps: List[dict]) -> str:
     rows = []
     for d in dumps:
@@ -355,13 +413,15 @@ def flight_summary_section(dumps: List[dict]) -> str:
             str(d.get("rank", "?")),
             d.get("reason", "-"),
             str(cs.get("event_seq", "-")),
+            str(_dump_dropped(d)),
             str(n_open),
             str(len(d.get("threads", []))),
             d.get("_path", "-"),
         ])
     head = f"flight dumps ({len(dumps)} rank(s))"
     return head + "\n" + _table(
-        ["rank", "reason", "events", "open", "threads", "file"], rows)
+        ["rank", "reason", "events", "dropped", "open", "threads", "file"],
+        rows)
 
 
 def flight_desync_section(dumps: List[dict]) -> str:
@@ -442,7 +502,18 @@ def flight_timeline_section(dumps: List[dict], max_events: int = 60) -> str:
         ])
     head = "merged timeline"
     if dropped:
-        head += f" (last {max_events} of {max_events + dropped} events)"
+        head += (f" (showing last {max_events} of {max_events + dropped} "
+                 f"merged events; {dropped} older event(s) truncated "
+                 "here — raise --events to see them)")
+    ring_lost = {d.get("rank", "?"): _dump_dropped(d) for d in dumps
+                 if _dump_dropped(d)}
+    if ring_lost:
+        head += ("\nRING OVERFLOW: "
+                 + ", ".join(f"rank {r} lost {n} event(s)"
+                             for r, n in sorted(ring_lost.items(),
+                                                key=lambda kv: str(kv[0])))
+                 + " before the dump (CHAINERMN_TPU_FLIGHT_CAPACITY "
+                   "bounds the ring)")
     if not rows:
         return head + "\nno events recorded"
     return head + "\n" + _table(
@@ -516,12 +587,104 @@ def flight_fsdp_lane_section(dumps: List[dict], width: int = 48) -> str:
         ["rank", "lane", "timeline", "dur", "bytes"], rows)
 
 
+def _dump_events_by_rank(dumps: List[dict]) -> Dict[int, List[dict]]:
+    return {int(d.get("rank", i)): d.get("events", [])
+            for i, d in enumerate(dumps)}
+
+
+def _dump_offsets(dumps: List[dict]) -> Dict[int, float]:
+    """Per-rank clock offsets INTO rank 0's timebase, from the
+    watchdog-handshake ``clock`` sections embedded in the dumps.  A
+    rank's own dump carries its offsets TO each peer (``local + off ≈
+    peer``), so rank R's shift is its offset to rank 0; when R's dump
+    lacks one, rank 0's offset to R (negated) is the fallback.  Dumps
+    without clock sections (single-host runs) shift by zero."""
+    out: Dict[int, float] = {}
+    by_rank = {int(d.get("rank", i)): d for i, d in enumerate(dumps)}
+    ref = by_rank.get(0, {})
+    ref_offsets = (ref.get("clock") or {}).get("offsets", {})
+    for r, d in by_rank.items():
+        if r == 0:
+            out[r] = 0.0
+            continue
+        own = ((d.get("clock") or {}).get("offsets", {})).get("0")
+        if own is not None:
+            out[r] = float(own.get("offset_s", 0.0))
+        elif str(r) in ref_offsets:
+            out[r] = -float(ref_offsets[str(r)].get("offset_s", 0.0))
+        else:
+            out[r] = 0.0
+    return out
+
+
+def flight_attribution_report(dumps: List[dict]) -> dict:
+    """The cross-rank attribution document for a set of dumps (offsets
+    applied from any embedded clock handshake)."""
+    from chainermn_tpu.observability import attribution as _attr
+
+    return _attr.attribution_report(_dump_events_by_rank(dumps),
+                                    offsets=_dump_offsets(dumps))
+
+
+def flight_attribution_section(dumps: List[dict],
+                               max_steps: int = 8) -> str:
+    """Attribution lane (flight mode): per-step bucket decomposition on
+    every rank plus the cross-rank critical path of the slowest step."""
+    try:
+        rep = flight_attribution_report(dumps)
+    except Exception as e:  # noqa: BLE001 — report tool must not die
+        return f"attribution: failed to build span trees ({e})"
+    steps = rep.get("steps", [])
+    if not steps:
+        return ("attribution: no step spans in the dumps (no step/phase "
+                "events recorded)")
+    shown = steps[-max_steps:]
+    rows = []
+    for st in shown:
+        for r, a in sorted(st.get("ranks", {}).items(),
+                           key=lambda kv: int(kv[0])):
+            rows.append(_attr_row(f"it{st.get('iteration', '?')} r{r}", a))
+    head = (f"step-time attribution ({rep.get('n_steps')} step(s) x "
+            f"{rep.get('n_ranks')} rank(s)")
+    if len(shown) < len(steps):
+        head += f", last {len(shown)} step(s) shown"
+    head += ")"
+    out = head + "\n" + _table(list(_ATTR_HEADERS), rows)
+    slowest = max(steps, key=lambda s: s.get("step_s", 0.0))
+    cp = slowest.get("critical_path", [])
+    if cp:
+        crows = [[f"r{e.get('rank', '?')}", e.get("kind", "?"),
+                  e.get("name", "?"), _fmt_s(e.get("dur_s"))
+                  + (f"  (blocked by r{e['blocked_by_rank']})"
+                     if "blocked_by_rank" in e else "")]
+                 for e in cp]
+        out += (f"\n\ncritical path of the slowest step "
+                f"(it{slowest.get('iteration', '?')}, "
+                f"{_fmt_s(slowest.get('step_s'))})\n"
+                + _table(["rank", "kind", "span", "dur"], crows))
+    return out
+
+
+def write_trace(dumps: List[dict], out_path: str) -> str:
+    """Export the merged, offset-corrected timeline as Chrome/Perfetto
+    trace-event JSON (open in chrome://tracing or ui.perfetto.dev)."""
+    from chainermn_tpu.observability import attribution as _attr
+
+    trees = _attr.merge_ranks(_dump_events_by_rank(dumps),
+                              offsets=_dump_offsets(dumps))
+    doc = _attr.to_trace_events(trees)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return out_path
+
+
 def flight_report(dumps: List[dict], max_events: int = 60) -> str:
     parts = [
         flight_summary_section(dumps),
         flight_desync_section(dumps),
         flight_timeline_section(dumps, max_events=max_events),
         flight_fsdp_lane_section(dumps),
+        flight_attribution_section(dumps),
     ]
     return "\n\n".join(p for p in parts if p)
 
@@ -586,9 +749,18 @@ def main(argv=None) -> int:
     ap.add_argument("--serving", action="store_true",
                     help="print only the serving lane (shorthand for "
                          "--section serving)")
+    ap.add_argument("--attribution", action="store_true",
+                    help="print only the step-time attribution lane "
+                         "(metrics mode: step_attribution records; with "
+                         "--flight: per-step buckets + critical path "
+                         "rebuilt from the dumps)")
     ap.add_argument("--flight", action="store_true",
                     help="merge per-rank flight_<rank>.json hang dumps "
                          "into one timeline")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="with --flight: also export the merged, clock-"
+                         "corrected timeline as Chrome/Perfetto trace-"
+                         "event JSON (chrome://tracing, ui.perfetto.dev)")
     ap.add_argument("--events", type=int, default=60, metavar="N",
                     help="max merged timeline events to print "
                          "(--flight mode, default 60)")
@@ -614,11 +786,21 @@ def main(argv=None) -> int:
             print(f"no flight dumps found in {' '.join(args.path)}",
                   file=sys.stderr)
             return 1
-        out = flight_report(dumps, max_events=args.events)
+        if args.attribution:
+            out = flight_attribution_section(dumps)
+        else:
+            out = flight_report(dumps, max_events=args.events)
+        if args.trace:
+            write_trace(dumps, args.trace)
+            out += f"\n\ntrace-event JSON written to {args.trace}"
         if lint_out:
             out += "\n\n" + lint_out
         print(out)
         return 0
+
+    if args.trace:
+        ap.error("--trace needs --flight (the trace is rebuilt from "
+                 "flight dumps)")
 
     if lint_out is not None and not args.path:
         print(lint_out)
@@ -636,9 +818,11 @@ def main(argv=None) -> int:
         args.section = "compression"
     if args.serving and not args.section:
         args.section = "serving"
+    if args.attribution and not args.section:
+        args.section = "attribution"
     names = [args.section] if args.section else \
         ["steps", "collectives", "straggler", "bench", "compression",
-         "serving"]
+         "serving", "attribution"]
     out = "\n\n".join(SECTIONS[n](records) for n in names)
     if lint_out:
         out += "\n\n" + lint_out
