@@ -99,7 +99,28 @@ def main():
                              "measurement in us_measured; the doc "
                              "records dcn_gbps so the table's "
                              "provenance is explicit")
+    parser.add_argument("--link-gbps", default=None, metavar="ici=X,dcn=Y",
+                        help="generalizes --dcn-gbps to a per-link-class "
+                             "bandwidth declaration: adds the per-link "
+                             "cost model's predicted wire time "
+                             "(planner.plan_modeled_time_s — max over "
+                             "concurrent groups AND over link busy "
+                             "times, not a sum) to each measured row, so "
+                             "striped candidates are priced on the "
+                             "heterogeneous links they exist for.  "
+                             "Mutually exclusive with --dcn-gbps; rows "
+                             "keep the raw measurement in us_measured "
+                             "and the doc records link_gbps")
+    parser.add_argument("--stripe-ratios", default=None,
+                        help="comma-separated ICI-stripe split ratios "
+                             "(e.g. 0.5,0.6,0.7,0.8,0.9) to add striped "
+                             "candidate plans (planner.striped_plan) to "
+                             "the --sweep grid; off by default so "
+                             "pre-striping sweeps reproduce")
     args = parser.parse_args()
+    if args.dcn_gbps and args.link_gbps:
+        parser.error("--dcn-gbps and --link-gbps are mutually exclusive "
+                     "(--link-gbps ici=inf,dcn=X is the superset)")
 
     import jax
     import jax.numpy as jnp
@@ -217,6 +238,26 @@ def main():
                   f"{row['time_ms']} ms, {row['busbw_gbps']} GB/s bus",
                   file=sys.stderr)
     return results
+
+
+def _parse_link_gbps(spec):
+    """``"ici=100,dcn=0.5"`` -> ``{"ici": 100.0, "dcn": 0.5}``.  Only
+    the two link classes the cost model prices are accepted; a missing
+    class is treated as free (infinite bandwidth) downstream."""
+    out = {}
+    for part in str(spec).split(","):
+        if not part.strip():
+            continue
+        name, sep, val = part.partition("=")
+        name = name.strip()
+        if not sep or name not in ("ici", "dcn"):
+            raise ValueError(
+                f"--link-gbps expects ici=X,dcn=Y (GB/s), got {spec!r}")
+        out[name] = float(val)
+    if not out:
+        raise ValueError(
+            f"--link-gbps expects ici=X,dcn=Y (GB/s), got {spec!r}")
+    return out
 
 
 def _time_spmd(comm, body, stacked, iters, warmup):
@@ -351,7 +392,7 @@ def _sweep(args):
     import chainermn_tpu
     from chainermn_tpu.planner import (
         SWEEP_SCHEMA, candidate_plans, execute_plan, load_plan,
-        plan_compressed_hops, plan_dcn_bytes)
+        plan_compressed_hops, plan_dcn_bytes, plan_modeled_time_s)
 
     kwargs = {}
     if args.intra_size is not None:
@@ -359,7 +400,11 @@ def _sweep(args):
     comm = chainermn_tpu.create_communicator("naive", **kwargs)
     topo = comm.plan_topology()
     n = comm.size
-    plans = list(candidate_plans(topo))
+    stripe_ratios = tuple(
+        float(s) for s in args.stripe_ratios.split(",")
+    ) if args.stripe_ratios else ()
+    link_gbps = _parse_link_gbps(args.link_gbps) if args.link_gbps else None
+    plans = list(candidate_plans(topo, stripe_ratios=stripe_ratios))
     if args.plan:
         plans.append(load_plan(args.plan))
     rows = []
@@ -391,6 +436,16 @@ def _sweep(args):
                 row["us_measured"] = row["us"]
                 row["us"] = round(
                     us + dcn_bytes / (args.dcn_gbps * 1e9) * 1e6, 3)
+            elif link_gbps:
+                # selection metric = measurement + per-link modeled wire
+                # time (max over concurrent groups / link busy times —
+                # what lets a striped candidate's hidden hops show up as
+                # the speedup they are on heterogeneous links)
+                modeled = plan_modeled_time_s(plan, topo, payload,
+                                              link_gbps, dtype=args.dtype)
+                row["us_measured"] = row["us"]
+                row["us_modeled_wire"] = round(modeled * 1e6, 3)
+                row["us"] = round(us + modeled * 1e6, 3)
             size_dcn[plan.name] = (
                 dcn_bytes, bool(plan_compressed_hops(plan, topo)))
             rows.append(row)
@@ -421,6 +476,10 @@ def _sweep(args):
            "rows": rows}
     if args.dcn_gbps:
         doc["dcn_gbps"] = args.dcn_gbps
+    if link_gbps:
+        doc["link_gbps"] = link_gbps
+    if stripe_ratios:
+        doc["stripe_ratios"] = list(stripe_ratios)
     if dcn_summary:
         doc["dcn"] = dcn_summary
         # the largest swept size's row, under a stable dotted path the

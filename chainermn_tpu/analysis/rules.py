@@ -199,6 +199,47 @@ CPU_WIRE_PROMOTIONS = {
 }
 
 
+def _interleaves(seqs, observed, match=None) -> bool:
+    """True iff ``observed`` is a valid interleaving of the sequences in
+    ``seqs`` — each sequence's internal order preserved, elements freely
+    merged across sequences.  ``match(want, got)`` compares elements
+    (default ``==``).
+
+    This is the census comparison for striped plans: XLA is free to
+    reorder collectives from INDEPENDENT concurrent stage groups (they
+    share no data), so the compiled schedule is only required to be SOME
+    interleaving of the per-group expected sequences, never an arbitrary
+    permutation — within a group the chain order is a data dependency
+    and must survive.  Memoized DP over per-sequence cursors.
+    """
+    if match is None:
+        def match(w, g):
+            return w == g
+    seqs = [tuple(s) for s in seqs]
+    observed = tuple(observed)
+    if sum(len(s) for s in seqs) != len(observed):
+        return False
+    memo: Dict[tuple, bool] = {}
+
+    def _ok(idx: tuple) -> bool:
+        pos = sum(idx)
+        if pos == len(observed):
+            return True
+        if idx in memo:
+            return memo[idx]
+        res = False
+        for gi, s in enumerate(seqs):
+            j = idx[gi]
+            if j < len(s) and match(s[j], observed[pos]):
+                if _ok(idx[:gi] + (j + 1,) + idx[gi + 1:]):
+                    res = True
+                    break
+        memo[idx] = res
+        return res
+
+    return _ok(tuple(0 for _ in seqs))
+
+
 @rule("census-drift", "error",
       "compiled allreduce_grad decomposition must match the flavor's "
       "plan-derived census",
@@ -208,6 +249,67 @@ def _census_drift(ctx) -> List[Finding]:
     plan = getattr(ctx, "plan", None)
     flavor = getattr(ctx, "flavor", None)
     topo = None
+    if plan is not None and getattr(plan, "groups", None) is not None:
+        # striped plan: the groups are data-independent, so XLA may
+        # interleave (but not reorder within) their chains — the
+        # compiled schedule must be a valid interleaving of the
+        # per-group expected sequences, first on kinds, then on
+        # (kind, wire-dtype) lanes with the CPU promotion tolerance.
+        from chainermn_tpu.planner.compiler import (
+            plan_census_kinds, plan_wire_dtypes)
+        from chainermn_tpu.planner.ir import PlanTopology
+        comm = getattr(ctx, "comm", None)
+        topo = (comm.plan_topology() if comm is not None else
+                PlanTopology(axes=(("inter", inter), ("intra", 1))))
+        n_groups = len(plan.groups)
+        group_kinds = [tuple(plan_census_kinds(plan, topo, group=g))
+                       for g in range(n_groups)]
+        got = tuple(ctx.census_schedule.kinds())
+        if not _interleaves(group_kinds, got):
+            return [_finding(
+                f"striped plan {plan.name!r} compiled allreduce_grad to "
+                f"{list(got) or '<no collectives>'} which is not an "
+                f"interleaving of its {n_groups} concurrent stage "
+                f"groups' expected sequences "
+                f"{[list(s) for s in group_kinds]} (inter_size={inter})."
+                f"  Groups are independent so XLA may merge their "
+                f"chains, but each group's internal order is a data "
+                f"dependency — drift here means a stripe lost or grew a "
+                f"hop and the per-link cost model prices a schedule the "
+                f"program does not run.",
+                expected_groups=[list(s) for s in group_kinds],
+                observed=list(got), plan=plan.name, inter_size=inter)]
+        group_lanes = []
+        for g in range(n_groups):
+            dts = plan_wire_dtypes(plan, topo, group=g)
+            group_lanes.append(tuple(
+                (k, NP_TO_HLO_DTYPE.get(d, d))
+                for k, d in zip(group_kinds[g], dts)))
+        got_lanes = tuple((op.kind, op.dtype)
+                          for op in ctx.census_schedule)
+
+        def _lane_match(w, g):
+            return (w[0] == g[0]
+                    and (g[1] == w[1]
+                         or g[1] in CPU_WIRE_PROMOTIONS.get(w[1], ())))
+
+        if not _interleaves(group_lanes, got_lanes, _lane_match):
+            return [_finding(
+                f"striped plan {plan.name!r} compiled collectives "
+                f"{[list(l) for l in got_lanes]} do not interleave its "
+                f"per-group (kind, wire-dtype) lanes "
+                f"{[[list(l) for l in grp] for grp in group_lanes]}: "
+                f"some hop runs at a width its stripe does not declare."
+                f"  A compressed DCN stripe whose codes never hit the "
+                f"wire is compression silently off at full wire cost; a "
+                f"narrower-than-declared stripe silently drops numerics "
+                f"— either way plan_link_bytes prices a wire the "
+                f"program does not move.",
+                expected_group_lanes=[[list(l) for l in grp]
+                                      for grp in group_lanes],
+                observed_lanes=[list(l) for l in got_lanes],
+                plan=plan.name, inter_size=inter)]
+        return []
     if plan is not None:
         # explicit plan spec (e.g. an autotuned table entry) — derive
         # the census against the communicator's declared topology
@@ -466,19 +568,30 @@ def _wire_dtype_mismatch(ctx) -> List[Finding]:
             wire = np.dtype(plan.wire_dtype).name
             wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
                           f"plan {plan.name!r} wire_dtype {wire!r}"))
-        for i, st in enumerate(getattr(plan, "stages", ()) or ()):
-            if getattr(st, "compression", None):
-                comp = st.compressor()
-                wire = np.dtype(
-                    str(comp.wire_dtype_for(np.dtype("float32")))).name
-                wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
-                              f"plan {plan.name!r} stage {i} ({st.op}) "
-                              f"compressor {comp.name!r} wire {wire!r}"))
-            elif getattr(st, "wire_dtype", None):
-                wire = np.dtype(st.wire_dtype).name
-                wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
-                              f"plan {plan.name!r} stage {i} ({st.op}) "
-                              f"wire_dtype {wire!r}"))
+        # walk concurrent stage groups too: a striped plan keeps its
+        # stages under plan.groups (plan.stages is empty), and its
+        # compressed-DCN stripe's wire must be in the program exactly
+        # like a plain compressed hop's
+        if getattr(plan, "groups", None) is not None:
+            chains = [(f" group {g} stage ", grp.stages)
+                      for g, grp in enumerate(plan.groups)]
+        else:
+            chains = [(" stage ", getattr(plan, "stages", ()) or ())]
+        for prefix, stages in chains:
+            for i, st in enumerate(stages):
+                if getattr(st, "compression", None):
+                    comp = st.compressor()
+                    wire = np.dtype(
+                        str(comp.wire_dtype_for(np.dtype("float32")))).name
+                    wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
+                                  f"plan {plan.name!r}{prefix}{i} "
+                                  f"({st.op}) compressor {comp.name!r} "
+                                  f"wire {wire!r}"))
+                elif getattr(st, "wire_dtype", None):
+                    wire = np.dtype(st.wire_dtype).name
+                    wires.append((NP_TO_HLO_DTYPE.get(wire, wire),
+                                  f"plan {plan.name!r}{prefix}{i} "
+                                  f"({st.op}) wire_dtype {wire!r}"))
         observed = [op.dtype for op in ctx.hlo_schedule
                     if op.kind in ("all-reduce", "reduce-scatter",
                                    "all-gather", "collective-permute")]

@@ -86,7 +86,11 @@ def _span_key(ev: dict) -> Optional[tuple]:
     (leg, bucket) — each mirrors how its emitter sequences edges."""
     k = ev.get("kind", "")
     if k.startswith("plan_stage_"):
-        return ("plan_stage", ev.get("plan"), ev.get("stage"))
+        # ``group`` disambiguates concurrent stripes of a striped plan
+        # (stage 0 of group 0 vs stage 0 of group 1); absent/None for
+        # plain plans and events recorded before striping existed.
+        return ("plan_stage", ev.get("plan"), ev.get("group"),
+                ev.get("stage"))
     if k.startswith("fsdp_gather_") or k.startswith("fsdp_scatter_"):
         leg = k.split("_")[1]
         return ("fsdp", leg, ev.get("bucket"))
@@ -99,7 +103,9 @@ def _span_key(ev: dict) -> Optional[tuple]:
 def _span_from_pair(begin: dict, end: dict, rank: int) -> Span:
     k = begin.get("kind", "")
     if k.startswith("plan_stage_"):
-        name = (f"plan_stage {begin.get('plan', '?')}:"
+        grp = begin.get("group")
+        tag = f"g{grp}:" if grp is not None else ""
+        name = (f"plan_stage {begin.get('plan', '?')}:{tag}"
                 f"{begin.get('stage', '?')} {begin.get('op', '?')} "
                 f"{begin.get('scope', '?')}")
         kind = "plan_stage"
@@ -250,7 +256,9 @@ class PlanObs:
     global stream on rank 0, the attribution merge needs per-controller
     events to see cross-host skew.
 
-    Metric family (labels ``plan``/``stage``/``op``/``scope``/``link``):
+    Metric family (labels ``plan``/``stage``/``op``/``scope``/``link``/
+    ``group`` — ``group`` is the concurrent stripe index of a striped
+    plan, ``"-"`` for plain plans):
 
     * ``plan_stage_seconds`` (histogram) — host-observed latency between
       a stage's begin and end callbacks;
@@ -277,15 +285,19 @@ class PlanObs:
                 "wire bytes moved per executed plan stage")
 
     def edge(self, edge: str, plan: str, stage: int, op: str, scope: str,
-             link: str, nbytes: int) -> None:
+             link: str, nbytes: int, group: Optional[int] = None) -> None:
         now = time.perf_counter()
-        key = (plan, stage)
+        key = (plan, group, stage)
         if self.flight is not None:
-            self.flight.record(f"plan_stage_{edge}", plan=plan, stage=stage,
-                               op=op, scope=scope, link=link, nbytes=nbytes)
+            kw = dict(plan=plan, stage=stage, op=op, scope=scope,
+                      link=link, nbytes=nbytes)
+            if group is not None:
+                kw["group"] = group
+            self.flight.record(f"plan_stage_{edge}", **kw)
         if self.registry is not None:
             labels = {"plan": plan, "stage": str(stage), "op": op,
-                      "scope": scope, "link": link}
+                      "scope": scope, "link": link,
+                      "group": str(group) if group is not None else "-"}
             if edge == "begin":
                 self._begin[key] = now
             else:
@@ -295,14 +307,17 @@ class PlanObs:
                 self._bytes.inc(nbytes, **labels)
 
     def make_callback(self, edge: str, plan: str, stage: int, op: str,
-                      scope: str, link: str, nbytes: int):
+                      scope: str, link: str, nbytes: int,
+                      group: Optional[int] = None):
         """A rank-gated debug callback for one stage edge.  Called with
         ``(rank_idx, _dep)`` — ``_dep`` pins when the device reaches the
-        edge (the stage's input on begin, its output on end)."""
+        edge (the stage's input on begin, its output on end).  ``group``
+        is the concurrent stripe index for striped plans."""
 
         def cb(rank_idx, _dep):
             if int(rank_idx) == self.rep_rank:
-                self.edge(edge, plan, stage, op, scope, link, nbytes)
+                self.edge(edge, plan, stage, op, scope, link, nbytes,
+                          group=group)
         return cb
 
 

@@ -22,11 +22,15 @@ from chainermn_tpu.planner.autotune import (
     validate_sweep_rows,
 )
 from chainermn_tpu.planner.compiler import (
+    LINK_CLASS,
     execute_plan,
     init_plan_compression_states,
     plan_census_kinds,
     plan_compressed_hops,
     plan_dcn_bytes,
+    plan_group_lengths,
+    plan_link_bytes,
+    plan_modeled_time_s,
     plan_stage_lengths,
     plan_wire_bytes,
     plan_wire_dtypes,
@@ -38,18 +42,24 @@ from chainermn_tpu.planner.ir import (
     SCOPES,
     STAGE_OPS,
     Stage,
+    StageGroup,
     load_plan,
 )
 from chainermn_tpu.planner.plans import (
     FLAVOR_NAMES,
+    STRIPE_RATIOS,
+    broadcast_plans,
     candidate_plans,
     flavor_plan,
+    multicast_plan,
+    striped_plan,
 )
 
 __all__ = [
     "BUCKET_EDGES",
     "FIXED_PLAN_NAMES",
     "FLAVOR_NAMES",
+    "LINK_CLASS",
     "PLAN_TABLE_SCHEMA",
     "Plan",
     "PlanError",
@@ -57,20 +67,28 @@ __all__ = [
     "PlanTopology",
     "SCOPES",
     "STAGE_OPS",
+    "STRIPE_RATIOS",
     "SWEEP_SCHEMA",
     "Stage",
+    "StageGroup",
     "autotune_from_rows",
+    "broadcast_plans",
     "candidate_plans",
     "execute_plan",
     "flavor_plan",
     "init_plan_compression_states",
     "load_plan",
+    "multicast_plan",
     "plan_census_kinds",
     "plan_compressed_hops",
     "plan_dcn_bytes",
+    "plan_group_lengths",
+    "plan_link_bytes",
+    "plan_modeled_time_s",
     "plan_stage_lengths",
     "plan_wire_bytes",
     "plan_wire_dtypes",
     "size_bucket",
+    "striped_plan",
     "validate_sweep_rows",
 ]

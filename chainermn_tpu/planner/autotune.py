@@ -75,18 +75,24 @@ class PlanTable:
         if exact is not None:
             return exact
         # nearest bucket for the same (topology, dtype): tuning runs may
-        # not have swept every rung of the ladder
+        # not have swept every rung of the ladder.  Equidistant neighbors
+        # break toward the SMALLER bucket — a plan tuned on a smaller
+        # payload degrades more gracefully when extrapolated up than a
+        # large-payload pick (e.g. a striped split whose slices round to
+        # nothing) does when extrapolated down — and the deterministic
+        # tie keeps table lookups reproducible across dict orderings.
         ladder = [size_bucket(e) for e in BUCKET_EDGES] + [
             size_bucket(BUCKET_EDGES[-1] + 1)]
         want = ladder.index(size_bucket(nbytes))
         best = None
-        best_dist = None
+        best_key = None
         for (t, d, b), plan in self.entries.items():
             if t != tkey or d != dtype or b not in ladder:
                 continue
-            dist = abs(ladder.index(b) - want)
-            if best_dist is None or dist < best_dist:
-                best, best_dist = plan, dist
+            idx = ladder.index(b)
+            key = (abs(idx - want), 0 if idx < want else 1, idx)
+            if best_key is None or key < best_key:
+                best, best_key = plan, key
         return best
 
     def to_dict(self) -> dict:
@@ -146,14 +152,20 @@ def autotune_from_rows(rows: List[dict]):
     cell::
 
         {"topology", "dtype", "bucket", "tuned_plan", "tuned_us",
-         "best_fixed_plan", "best_fixed_us", "speedup"}
+         "best_fixed_plan", "best_fixed_us", "speedup",
+         "tuned_striped", "best_single_plan", "best_single_us",
+         "striped_speedup"}
 
     ``speedup > 1`` means the tuned pick beats the best fixed flavor in
     that cell — the acceptance criterion ``tools/perf_gate.py
     --planner`` gates on (it requires at least one strictly-better
-    cell).  Within a cell a plan's time is the MEAN over the sweep's
-    sizes in that bucket, so a plan must win across the bucket, not on
-    one lucky rung.
+    cell).  The striped lane compares against the best SINGLE-path plan
+    (fixed flavors AND single-chain candidates): when the cell's winner
+    is a striped plan, ``striped_speedup = best_single_us / tuned_us``
+    — the heterogeneous-link striping win the PLANNER_GATE_STRIPED leg
+    requires on ``--require-striped`` cells.  Within a cell a plan's
+    time is the MEAN over the sweep's sizes in that bucket, so a plan
+    must win across the bucket, not on one lucky rung.
     """
     validate_sweep_rows(rows)
     # cell -> plan name -> [(us, plan_spec)]
@@ -168,9 +180,19 @@ def autotune_from_rows(rows: List[dict]):
         tkey, dtype, bucket = cell
         means = {name: sum(u for u, _ in samples) / len(samples)
                  for name, samples in by_plan.items()}
+
+        def _is_striped(name: str) -> bool:
+            spec = next((s for _, s in by_plan[name] if s is not None),
+                        None)
+            return bool(spec and spec.get("groups"))
+
         tuned_name = min(means, key=lambda n: means[n])
         fixed = {n: u for n, u in means.items() if n in FIXED_PLAN_NAMES}
         best_fixed = min(fixed, key=lambda n: fixed[n]) if fixed else None
+        single = {n: u for n, u in means.items() if not _is_striped(n)}
+        best_single = (min(single, key=lambda n: single[n])
+                       if single else None)
+        tuned_striped = _is_striped(tuned_name)
         spec = next((s for _, s in by_plan[tuned_name] if s is not None),
                     None)
         plan = (Plan.from_dict(spec) if spec is not None
@@ -184,6 +206,12 @@ def autotune_from_rows(rows: List[dict]):
             "best_fixed_us": fixed.get(best_fixed) if best_fixed else None,
             "speedup": (fixed[best_fixed] / means[tuned_name])
             if best_fixed else None,
+            "tuned_striped": tuned_striped,
+            "best_single_plan": best_single,
+            "best_single_us": single.get(best_single)
+            if best_single else None,
+            "striped_speedup": (single[best_single] / means[tuned_name])
+            if (tuned_striped and best_single) else None,
         })
     return table, comparison
 

@@ -90,31 +90,59 @@ def _quantizer_for(st: Stage):
     return comp if _cq.is_quantizing(comp) else None
 
 
+def plan_group_lengths(plan: Plan, length: int) -> List[int]:
+    """Element count of each concurrent group's slice of a packed flat
+    buffer of ``length`` elements.  Boundaries land at
+    ``round(length * cumulative_ratio)`` — deterministic Python ints at
+    trace time, monotone, and summing exactly to ``length`` (the last
+    group absorbs the rounding remainder).  A plain plan is one group
+    owning the whole buffer."""
+    groups = plan.stage_groups()
+    bounds = [0]
+    cum = 0.0
+    for grp in groups[:-1]:
+        cum += grp.ratio
+        b = int(round(length * cum))
+        bounds.append(min(max(b, bounds[-1]), int(length)))
+    bounds.append(int(length))
+    return [bounds[i + 1] - bounds[i] for i in range(len(groups))]
+
+
+def _stage_at(plan: Plan, key) -> Stage:
+    """The Stage a hop key addresses: ``(group, stage)`` tuples for
+    striped plans, bare stage indices for plain ones."""
+    if isinstance(key, tuple):
+        g, i = key
+        return plan.groups[g].stages[i]
+    return plan.stages[key]
+
+
 def plan_compressed_hops(plan: Plan,
                          topology: Optional[PlanTopology] = None) -> Dict:
-    """``{stage_index: Compressor}`` for every stage carrying a stateful
-    quantizer.  With a ``topology``, stages whose scope resolves to no
-    axes are dropped (the compiler skips them, so they hold no state)."""
+    """``{hop_key: Compressor}`` for every stage carrying a stateful
+    quantizer — ``hop_key`` is the stage index for a plain plan and a
+    ``(group, stage)`` tuple for a striped one (two groups may each own
+    a compressed stage 0; their EF states must not collide).  With a
+    ``topology``, stages whose scope resolves to no axes are dropped
+    (the compiler skips them, so they hold no state)."""
     hops = {}
-    for i, st in enumerate(plan.stages):
-        if topology is not None and not topology.scope_axes(st.scope):
-            continue
-        comp = _quantizer_for(st)
-        if comp is not None:
-            hops[i] = comp
+    striped = plan.groups is not None
+    for g, grp in enumerate(plan.stage_groups()):
+        for i, st in enumerate(grp.stages):
+            if topology is not None and not topology.scope_axes(st.scope):
+                continue
+            comp = _quantizer_for(st)
+            if comp is not None:
+                hops[(g, i) if striped else i] = comp
     return hops
 
 
-def plan_stage_lengths(plan: Plan, topology: PlanTopology,
-                       length: int) -> Dict[int, int]:
-    """Flat-buffer element count at ENTRY to each emitted stage — the
-    static mirror of ``_run_stages_flat``'s pad/shard bookkeeping, used
-    to size per-hop EF state (a compressed inter hop after a
-    reduce-scatter sees 1/intra of the packed buffer)."""
+def _chain_stage_lengths(stages, topology: PlanTopology,
+                         length: int) -> Dict[int, int]:
     lengths: Dict[int, int] = {}
     cur = int(length)
     stack: List[Tuple[int, int]] = []  # (orig_len, padded_len)
-    for i, st in enumerate(plan.stages):
+    for i, st in enumerate(stages):
         axes = topology.scope_axes(st.scope)
         if not axes:
             continue
@@ -130,12 +158,33 @@ def plan_stage_lengths(plan: Plan, topology: PlanTopology,
     return lengths
 
 
+def plan_stage_lengths(plan: Plan, topology: PlanTopology,
+                       length: int) -> Dict:
+    """Flat-buffer element count at ENTRY to each emitted stage — the
+    static mirror of ``_run_stages_flat``'s pad/shard bookkeeping, used
+    to size per-hop EF state (a compressed inter hop after a
+    reduce-scatter sees 1/intra of the packed buffer).  Keys follow
+    :func:`plan_compressed_hops`: bare indices for plain plans,
+    ``(group, stage)`` for striped plans, where each group's chain
+    starts from ITS slice length (``plan_group_lengths``)."""
+    if plan.groups is None:
+        return _chain_stage_lengths(plan.stages, topology, length)
+    lengths: Dict = {}
+    for g, (grp, ln) in enumerate(
+            zip(plan.stage_groups(), plan_group_lengths(plan, length))):
+        for i, val in _chain_stage_lengths(
+                grp.stages, topology, ln).items():
+            lengths[(g, i)] = val
+    return lengths
+
+
 def init_plan_compression_states(plan: Plan, topology: PlanTopology,
                                  length: int) -> Optional[Dict]:
     """Fresh per-hop EF states for ``plan`` over a packed buffer of
-    ``length`` float32 elements: ``{stage_index: CompressionState}``,
-    one per quantizing stage, each sized to the buffer AT that stage and
-    tagged with its stage index (``state.hop``) so the checkpoint
+    ``length`` float32 elements: ``{hop_key: CompressionState}``, one
+    per quantizing stage, each sized to the buffer AT that stage and
+    tagged with its hop key (``state.hop`` — the stage index, or the
+    ``(group, stage)`` tuple for a striped plan) so the checkpoint
     sidecar pins which hop carried which spec.  ``None`` when the plan
     has no quantizing stages."""
     hops = plan_compressed_hops(plan, topology)
@@ -143,10 +192,10 @@ def init_plan_compression_states(plan: Plan, topology: PlanTopology,
         return None
     lengths = plan_stage_lengths(plan, topology, length)
     states = {}
-    for i, comp in hops.items():
-        world = topology.scope_size(plan.stages[i].scope)
+    for key, comp in hops.items():
+        world = topology.scope_size(_stage_at(plan, key).scope)
         comp.clip_limit(world)  # fail early at unworkable scope sizes
-        states[i] = comp.init_state(lengths[i], world, hop=i)
+        states[key] = comp.init_state(lengths[key], world, hop=key)
     return states
 
 
@@ -208,7 +257,9 @@ def _compressed_psum(st: Stage, idx: int, axes, world: int, buf, state,
 
 
 def _stage_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
-                st: Stage, buf, edge: str, wire_bytes: Optional[float] = None):
+                st: Stage, buf, edge: str,
+                wire_bytes: Optional[float] = None,
+                group: Optional[int] = None):
     """Insert one per-stage span edge (``plan_stage_begin``/``_end``)
     as a device-side debug callback, data-dependent on one element of
     ``buf`` so it fires when the device reaches this point, gated inside
@@ -217,7 +268,9 @@ def _stage_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
     same way :func:`plan_dcn_bytes` does: ``intra`` rides ICI, ``inter``
     and ``all`` cross the DCN boundary.  ``wire_bytes`` overrides the
     payload size (the leaf-packing path prices the whole tree, not the
-    representative leaf the callback rides on)."""
+    representative leaf the callback rides on).  ``group`` tags the
+    event with the concurrent stripe index of a striped plan — stage 0
+    of group 0 and stage 0 of group 1 are different spans."""
     if pobs is None:
         return
     ridx = lax.axis_index(_axis_arg(topology.scope_axes("all")))
@@ -226,7 +279,7 @@ def _stage_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
             plan, st, float(buf.shape[0]), jnp.dtype(buf.dtype).itemsize)
     link = "ici" if st.scope == "intra" else "dcn"
     cb = pobs.make_callback(edge, plan.name, i, st.op, st.scope, link,
-                            int(round(wire_bytes)))
+                            int(round(wire_bytes)), group=group)
     # Device-side gate: only one shard per controller (global index a
     # multiple of the per-controller device count) pays the host
     # round-trip — the SAME predicate on every controller, so the SPMD
@@ -241,10 +294,14 @@ def _stage_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
 
 
 def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
-                     states: Optional[Dict] = None, obs=None, pobs=None):
-    """Apply the stage chain to one flat buffer.  ``states`` maps stage
-    index -> per-hop CompressionState for quantizing stages; returns
-    ``(buf, new_states)`` (``new_states`` empty when nothing is
+                     states: Optional[Dict] = None, obs=None, pobs=None,
+                     group: Optional[int] = None):
+    """Apply one stage chain to one flat buffer.  ``group`` selects a
+    concurrent group's chain (striped plans — ``buf`` is that group's
+    slice and hop keys become ``(group, stage)`` tuples); ``None`` runs
+    a plain plan's ``stages`` with bare stage-index keys.  ``states``
+    maps hop key -> per-hop CompressionState for quantizing stages;
+    returns ``(buf, new_states)`` (``new_states`` empty when nothing is
     stateful).  ``pobs`` (a :class:`spans.PlanObs`, or ``None`` when
     observability is off) brackets every emitted stage with
     ``plan_stage_begin``/``_end`` flight events — the attribution
@@ -254,22 +311,24 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
     states = dict(states or {})
     new_states: Dict = {}
     shard_stack: List[_ShardFrame] = []
-    for i, st in enumerate(plan.stages):
+    stages = plan.stages if group is None else plan.groups[group].stages
+    for i, st in enumerate(stages):
+        key = i if group is None else (group, i)
         axes = topology.scope_axes(st.scope)
         if not axes:
             continue
-        _stage_hook(pobs, plan, topology, i, st, buf, "begin")
+        _stage_hook(pobs, plan, topology, i, st, buf, "begin", group=group)
         quant = _quantizer_for(st)
         if quant is not None:
             world = topology.scope_size(st.scope)
-            state = states.get(i)
+            state = states.get(key)
             if state is None:
                 # One-shot path (benchmark sweeps, candidate validation):
                 # a cold EF state built inside the trace, discarded by
                 # the caller.  Training seams thread persistent states.
-                state = quant.init_state(int(buf.shape[0]), world, hop=i)
-            buf, new_states[i] = _compressed_psum(
-                st, i, axes, world, buf, state, obs)
+                state = quant.init_state(int(buf.shape[0]), world, hop=key)
+            buf, new_states[key] = _compressed_psum(
+                st, key, axes, world, buf, state, obs)
         elif st.op == "all-reduce":
             if st.compression is not None:
                 # identity compressor: exactly the wire-dtype cast path
@@ -330,7 +389,7 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
                              lambda b: lax.ppermute(b, axes[0], perm))
         else:  # pragma: no cover — ir validation rejects unknown ops
             raise PlanError(f"unknown stage op {st.op!r}")
-        _stage_hook(pobs, plan, topology, i, st, buf, "end")
+        _stage_hook(pobs, plan, topology, i, st, buf, "end", group=group)
     return buf, new_states
 
 
@@ -446,9 +505,42 @@ def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
     new_states: Dict = {}
     out_buffers = []
     for b in buffers:
-        b, st_out = _run_stages_flat(plan, topology, b, states=states,
-                                     obs=obs, pobs=pobs)
-        new_states.update(st_out)
+        if plan.groups is not None:
+            # Striped lowering: partition the packed buffer at its
+            # static ratio boundaries, run each concurrent group's
+            # chain over its slice (the chains are data-independent, so
+            # XLA interleaves them — the ICI stripe's hops overlap the
+            # DCN stripe's slow hop, no host joins), re-concatenate
+            # before unpack.  A single ratio-1.0 group skips the
+            # slice/concat entirely, keeping it bit-exact with the
+            # equivalent flat plan.
+            lens = plan_group_lengths(plan, int(b.shape[0]))
+            if len(lens) == 1:
+                b, st_out = _run_stages_flat(
+                    plan, topology, b, states=states, obs=obs,
+                    pobs=pobs, group=0)
+                new_states.update(st_out)
+            else:
+                parts = []
+                off = 0
+                for g, ln in enumerate(lens):
+                    seg = lax.slice_in_dim(b, off, off + ln)
+                    off += ln
+                    if ln == 0:
+                        # a tiny buffer can round a stripe to nothing;
+                        # an empty slice has no collective to run
+                        parts.append(seg)
+                        continue
+                    seg, st_out = _run_stages_flat(
+                        plan, topology, seg, states=states, obs=obs,
+                        pobs=pobs, group=g)
+                    new_states.update(st_out)
+                    parts.append(seg)
+                b = jnp.concatenate(parts)
+        else:
+            b, st_out = _run_stages_flat(plan, topology, b, states=states,
+                                         obs=obs, pobs=pobs)
+            new_states.update(st_out)
         out_buffers.append(b)
     result = _packing.unpack(out_buffers, meta, scale=1.0 / n)
     if states is not None:
@@ -467,7 +559,16 @@ _CENSUS_KIND = {
 }
 
 
-def plan_census_kinds(plan: Plan, topology: PlanTopology) -> tuple:
+def _group_stages(plan: Plan, group: Optional[int]):
+    """Stage chain(s) a census walk covers: one group's chain, or every
+    chain in group order (trace order) when ``group`` is None."""
+    if group is not None:
+        return plan.stage_groups()[group].stages
+    return tuple(st for grp in plan.stage_groups() for st in grp.stages)
+
+
+def plan_census_kinds(plan: Plan, topology: PlanTopology,
+                      group: Optional[int] = None) -> tuple:
     """Expected HLO collective-kind sequence of ``plan`` compiled against
     ``topology`` — the census, derived from the IR.
 
@@ -478,9 +579,16 @@ def plan_census_kinds(plan: Plan, topology: PlanTopology) -> tuple:
     the compiler); a stage over axes of size 1 IS counted — XLA keeps
     singleton-group collectives (measured on the CPU mesh; the old
     hand-written table got exactly this wrong at ``inter == 1``).
+
+    For a striped plan, ``group`` selects ONE concurrent group's
+    expected sequence; ``group=None`` concatenates the groups in trace
+    order.  Because the groups are data-independent, XLA may interleave
+    their collectives — compare per group (the census-drift rule checks
+    the observed program is a valid interleaving of the per-group
+    sequences, order preserved within each group).
     """
     kinds = []
-    for st in plan.stages:
+    for st in _group_stages(plan, group):
         if not topology.scope_axes(st.scope):
             continue
         if st.op == "all-gather" and st.lowering == "native":
@@ -491,19 +599,19 @@ def plan_census_kinds(plan: Plan, topology: PlanTopology) -> tuple:
 
 
 def plan_wire_dtypes(plan: Plan, topology: PlanTopology,
-                     dtype="float32") -> tuple:
+                     dtype="float32", group: Optional[int] = None) -> tuple:
     """Expected on-wire numpy dtype NAME per emitted stage, aligned with
-    :func:`plan_census_kinds` — the per-hop census the lint rules
-    compare against compiled HLO.  A compressed stage's wire is its
-    compressor's (``int8`` / ``float8_e4m3fn`` / an identity codec's
-    ``wire_dtype``); otherwise the stage wire dtype, the plan wire
-    dtype, then the payload ``dtype``, in that order."""
+    :func:`plan_census_kinds` (same ``group`` semantics) — the per-hop
+    census the lint rules compare against compiled HLO.  A compressed
+    stage's wire is its compressor's (``int8`` / ``float8_e4m3fn`` / an
+    identity codec's ``wire_dtype``); otherwise the stage wire dtype,
+    the plan wire dtype, then the payload ``dtype``, in that order."""
     payload = np.dtype(dtype).name if plan.wire_dtype is None \
         else np.dtype(plan.wire_dtype).name
     if plan_compressed_hops(plan, topology) and plan.wire_dtype is None:
         payload = "float32"  # quantizing plans pack one f32 buffer
     out = []
-    for st in plan.stages:
+    for st in _group_stages(plan, group):
         if not topology.scope_axes(st.scope):
             continue
         if st.compression is not None:
@@ -542,22 +650,14 @@ def _stage_wire_elem_bytes(plan: Plan, st: Stage, elems: float,
     return elems * wire_item
 
 
-def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
-                    dtype="float32") -> dict:
-    """Static per-scope wire-cost model of a plan moving ``nbytes`` of
-    ``dtype`` payload: bytes each scope's links carry per device, using
-    ring costs (all-reduce 2x, reduce-scatter/all-gather 1x, p2p
-    1/size).  Each stage is priced at ITS OWN wire width — stage
-    ``wire_dtype`` first, then the plan-level dtype, then the payload;
-    a quantizing stage at its compressor's wire width including the
-    chunk pad and per-chunk saturation-flag overhead.  Used by the
-    autotuner to break timing ties and by the docs to explain WHY a
-    plan wins a cell; not a substitute for measurement.
-    """
-    item = np.dtype(dtype).itemsize
-    costs: dict = {}
-    frac = 1.0  # fraction of the payload live at the current stage
-    for st in plan.stages:
+def _chain_stage_costs(plan: Plan, stages, topology: PlanTopology,
+                       nbytes: float, item: int) -> List[Tuple[str, float]]:
+    """Per emitted stage of one chain: ``(scope, bytes_moved)`` under
+    the ring cost model (all-reduce 2x, reduce-scatter/all-gather 1x,
+    p2p 1/size), each stage priced at its own wire width."""
+    out: List[Tuple[str, float]] = []
+    frac = 1.0  # fraction of the chain's payload live at this stage
+    for st in stages:
         axes = topology.scope_axes(st.scope)
         if not axes:
             continue
@@ -582,8 +682,95 @@ def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
             moved = stage_bytes
         else:  # pragma: no cover
             moved = stage_bytes
-        costs[st.scope] = costs.get(st.scope, 0.0) + moved
+        out.append((st.scope, moved))
+    return out
+
+
+def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
+                    dtype="float32") -> dict:
+    """Static per-scope wire-cost model of a plan moving ``nbytes`` of
+    ``dtype`` payload: bytes each scope's links carry per device, using
+    ring costs (all-reduce 2x, reduce-scatter/all-gather 1x, p2p
+    1/size).  Each stage is priced at ITS OWN wire width — stage
+    ``wire_dtype`` first, then the plan-level dtype, then the payload;
+    a quantizing stage at its compressor's wire width including the
+    chunk pad and per-chunk saturation-flag overhead.  A striped plan
+    sums across its concurrent groups, each group priced on its split
+    ratio of the payload.  Used by the autotuner to break timing ties
+    and by the docs to explain WHY a plan wins a cell; not a substitute
+    for measurement.
+    """
+    item = np.dtype(dtype).itemsize
+    costs: dict = {}
+    for grp in plan.stage_groups():
+        for scope, moved in _chain_stage_costs(
+                plan, grp.stages, topology, nbytes * grp.ratio, item):
+            costs[scope] = costs.get(scope, 0.0) + moved
     return costs
+
+
+#: scope -> physical link class its traffic rides: the intra (last) axis
+#: is the ICI domain, inter and flat-over-all traffic crosses the DCN
+#: boundary (the same classification _stage_hook tags spans with)
+LINK_CLASS = {"intra": "ici", "inter": "dcn", "all": "dcn"}
+
+
+def plan_link_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
+                    dtype="float32") -> dict:
+    """Per-(scope, link-class) wire bytes of ``plan`` moving ``nbytes``
+    of ``dtype`` payload, summed over a striped plan's concurrent
+    groups: ``{(scope, link): bytes}`` with ``link`` in {"ici", "dcn"}
+    per :data:`LINK_CLASS`.  The per-link ledger
+    :func:`plan_modeled_time_s` prices against declared per-link GB/s —
+    and the by-link marginal that tells you WHICH wire a candidate
+    stripe would relieve."""
+    costs = plan_wire_bytes(plan, topology, nbytes, dtype=dtype)
+    return {(scope, LINK_CLASS[scope]): moved
+            for scope, moved in costs.items()}
+
+
+def plan_modeled_time_s(plan: Plan, topology: PlanTopology, nbytes: int,
+                        link_gbps: Dict[str, float],
+                        dtype="float32") -> float:
+    """Predicted wire time (seconds) of ``plan`` moving ``nbytes`` of
+    ``dtype`` payload over links of declared bandwidth ``link_gbps``
+    (``{"ici": GB/s, "dcn": GB/s}``; a missing link class is free).
+
+    Two lower bounds, and the prediction is their max:
+
+    * **chain time, max over groups** — each concurrent group's stage
+      chain is sequentially dependent, so a group costs the SUM of its
+      stages' link times; the groups are data-independent, so the plan
+      costs the slowest group, NOT the sum of groups.  This is the
+      striping win: the ICI stripe's hops hide behind the DCN stripe's
+      slow hop.
+    * **link busy time, max over link classes** — concurrency cannot
+      exceed a wire: every byte all groups put on one link class still
+      serializes on that link, so splitting a plan into identical
+      stripes buys nothing.
+
+    A plain single-chain plan degenerates to its chain sum (which
+    dominates any one link's share).
+    """
+    item = np.dtype(dtype).itemsize
+
+    def _rate(link: str) -> float:
+        bw = link_gbps.get(link)
+        return float(bw) * 1e9 if bw else float("inf")
+
+    chain_times = []
+    link_busy: Dict[str, float] = {}
+    for grp in plan.stage_groups():
+        t = 0.0
+        for scope, moved in _chain_stage_costs(
+                plan, grp.stages, topology, nbytes * grp.ratio, item):
+            link = LINK_CLASS[scope]
+            dt = moved / _rate(link)
+            t += dt
+            link_busy[link] = link_busy.get(link, 0.0) + dt
+        chain_times.append(t)
+    busiest = max(link_busy.values()) if link_busy else 0.0
+    return max(max(chain_times, default=0.0), busiest)
 
 
 def plan_dcn_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
@@ -598,6 +785,7 @@ def plan_dcn_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
     return float(costs.get("inter", 0.0) + costs.get("all", 0.0))
 
 
-__all__ = ["execute_plan", "init_plan_compression_states",
+__all__ = ["LINK_CLASS", "execute_plan", "init_plan_compression_states",
            "plan_census_kinds", "plan_compressed_hops", "plan_dcn_bytes",
+           "plan_group_lengths", "plan_link_bytes", "plan_modeled_time_s",
            "plan_stage_lengths", "plan_wire_bytes", "plan_wire_dtypes"]

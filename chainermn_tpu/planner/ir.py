@@ -234,6 +234,88 @@ class Stage:
 
 
 @dataclass(frozen=True)
+class StageGroup:
+    """One concurrent stripe of a striped plan (FlexLink direction).
+
+    A group owns an ordered stage chain and a ``ratio`` — the fraction
+    of the packed flat buffer its chain runs over.  Groups of one plan
+    are data-independent (each works its own slice), so their chains
+    interleave at the XLA level: the ICI-heavy stripe's hops overlap the
+    DCN stripe's slow hop with no host joins.  Ratios across a plan's
+    groups must sum to 1.
+    """
+
+    stages: Tuple[Stage, ...]
+    ratio: float
+    #: optional tag for spans / debug output; defaults to "g{index}"
+    name: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "stages", tuple(self.stages))
+        object.__setattr__(self, "ratio", float(self.ratio))
+        if not self.stages:
+            raise PlanError("stage group has no stages")
+        for i, st in enumerate(self.stages):
+            if not isinstance(st, Stage):
+                raise PlanError(
+                    f"group stage {i} is not a Stage: {st!r}")
+        if not (0.0 < self.ratio <= 1.0):
+            raise PlanError(
+                f"group split ratio must be in (0, 1], got {self.ratio}")
+
+    def to_dict(self) -> dict:
+        d = {"ratio": self.ratio,
+             "stages": [s.to_dict() for s in self.stages]}
+        if self.name:
+            d["name"] = self.name
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StageGroup":
+        return cls(stages=tuple(Stage.from_dict(s) for s in d["stages"]),
+                   ratio=float(d["ratio"]), name=d.get("name", ""))
+
+
+def _validate_chain(plan_name: str, stages: Sequence[Stage],
+                    packing: str, where: str = "") -> None:
+    """Shard-stack validation of one stage chain (a plain plan's stages
+    or one concurrent group's)."""
+    at = f" in {where}" if where else ""
+    shard_stack = []
+    for i, st in enumerate(stages):
+        if not isinstance(st, Stage):
+            raise PlanError(f"stage {i}{at} is not a Stage: {st!r}")
+        if st.op == "reduce-scatter":
+            if packing != "flat":
+                raise PlanError(
+                    f"plan {plan_name!r}: reduce-scatter (stage {i}{at}) "
+                    "requires flat packing")
+            shard_stack.append(st.scope)
+        elif st.op == "all-gather":
+            if not shard_stack:
+                raise PlanError(
+                    f"plan {plan_name!r}: all-gather (stage {i}{at}) "
+                    "without a live reduce-scatter")
+            top = shard_stack.pop()
+            if top != st.scope:
+                raise PlanError(
+                    f"plan {plan_name!r}: all-gather (stage {i}{at}) over "
+                    f"scope {st.scope!r} does not match the innermost "
+                    f"reduce-scatter scope {top!r}")
+    if shard_stack:
+        raise PlanError(
+            f"plan {plan_name!r}{at} ends sharded over {shard_stack} — "
+            "every reduce-scatter needs a matching all-gather (or "
+            "the consumer must be a sharded-state engine like FSDP, "
+            "which has its own scheduler)")
+
+
+#: tolerance on sum(group ratios) == 1 — ratios are user-facing floats
+#: ("0.7" + "0.3"), not exact binary fractions
+RATIO_TOL = 1e-6
+
+
+@dataclass(frozen=True)
 class Plan:
     """An ordered collective decomposition — the communicator spec.
 
@@ -249,20 +331,66 @@ class Plan:
 
     ``wire_dtype`` is the packed-buffer communication dtype (the legacy
     ``allreduce_grad_dtype`` knob as plan data; flat packing only).
+
+    ``groups`` makes the plan *striped*: instead of one ``stages``
+    chain, the plan holds concurrent :class:`StageGroup` chains, each
+    running over its declared split ratio of the packed flat buffer
+    (ratios sum to 1).  ``groups`` and ``stages`` are mutually
+    exclusive, and striping requires flat packing — the split is a
+    slice of the packed buffer.
     """
 
     name: str
-    stages: Tuple[Stage, ...]
+    stages: Tuple[Stage, ...] = ()
     packing: str = "flat"
     wire_dtype: Optional[str] = None
+    groups: Optional[Tuple[StageGroup, ...]] = None
 
     def __post_init__(self):
         object.__setattr__(self, "stages", tuple(self.stages))
+        if self.groups is not None:
+            object.__setattr__(self, "groups", tuple(self.groups))
         self.validate()
+
+    @property
+    def is_striped(self) -> bool:
+        return self.groups is not None
+
+    def stage_groups(self) -> Tuple[StageGroup, ...]:
+        """The plan as concurrent groups: a striped plan's ``groups``
+        verbatim; a plain plan normalized to ONE ratio-1.0 group.  The
+        uniform view the cost model and lint rules walk."""
+        if self.groups is not None:
+            return self.groups
+        return (StageGroup(stages=self.stages, ratio=1.0),)
 
     def validate(self) -> "Plan":
         if self.packing not in ("flat", "leaf"):
             raise PlanError(f"unknown packing {self.packing!r}")
+        if self.groups is not None:
+            if self.stages:
+                raise PlanError(
+                    f"plan {self.name!r} has both stages and groups — "
+                    "a striped plan's chains live in its groups")
+            if self.packing != "flat":
+                raise PlanError(
+                    f"plan {self.name!r}: concurrent stage groups "
+                    "require flat packing — split ratios partition the "
+                    "packed flat buffer")
+            for g, grp in enumerate(self.groups):
+                if not isinstance(grp, StageGroup):
+                    raise PlanError(
+                        f"plan {self.name!r}: group {g} is not a "
+                        f"StageGroup: {grp!r}")
+                _validate_chain(self.name, grp.stages, self.packing,
+                                where=f"group {g}")
+            total = sum(grp.ratio for grp in self.groups)
+            if abs(total - 1.0) > RATIO_TOL:
+                raise PlanError(
+                    f"plan {self.name!r}: group split ratios "
+                    f"{[grp.ratio for grp in self.groups]} sum to "
+                    f"{total!r}, expected 1.0")
+            return self
         if not self.stages:
             raise PlanError(f"plan {self.name!r} has no stages")
         if self.wire_dtype is not None and self.packing != "flat":
@@ -273,48 +401,29 @@ class Plan:
             raise PlanError(
                 f"plan {self.name!r}: per-hop compression requires flat "
                 "packing — the EF state is sized to the packed buffer")
-        shard_stack = []
-        for i, st in enumerate(self.stages):
-            if not isinstance(st, Stage):
-                raise PlanError(f"stage {i} is not a Stage: {st!r}")
-            if st.op == "reduce-scatter":
-                if self.packing != "flat":
-                    raise PlanError(
-                        f"plan {self.name!r}: reduce-scatter (stage {i}) "
-                        "requires flat packing")
-                shard_stack.append(st.scope)
-            elif st.op == "all-gather":
-                if not shard_stack:
-                    raise PlanError(
-                        f"plan {self.name!r}: all-gather (stage {i}) "
-                        "without a live reduce-scatter")
-                top = shard_stack.pop()
-                if top != st.scope:
-                    raise PlanError(
-                        f"plan {self.name!r}: all-gather (stage {i}) over "
-                        f"scope {st.scope!r} does not match the innermost "
-                        f"reduce-scatter scope {top!r}")
-        if shard_stack:
-            raise PlanError(
-                f"plan {self.name!r} ends sharded over {shard_stack} — "
-                "every reduce-scatter needs a matching all-gather (or "
-                "the consumer must be a sharded-state engine like FSDP, "
-                "which has its own scheduler)")
+        _validate_chain(self.name, self.stages, self.packing)
         return self
 
     def to_dict(self) -> dict:
-        d = {"name": self.name, "packing": self.packing,
-             "stages": [s.to_dict() for s in self.stages]}
+        d = {"name": self.name, "packing": self.packing}
+        if self.groups is not None:
+            d["groups"] = [g.to_dict() for g in self.groups]
+        else:
+            d["stages"] = [s.to_dict() for s in self.stages]
         if self.wire_dtype is not None:
             d["wire_dtype"] = self.wire_dtype
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Plan":
+        groups = d.get("groups")
         return cls(name=d["name"],
-                   stages=tuple(Stage.from_dict(s) for s in d["stages"]),
+                   stages=tuple(Stage.from_dict(s)
+                                for s in d.get("stages", ())),
                    packing=d.get("packing", "flat"),
-                   wire_dtype=d.get("wire_dtype"))
+                   wire_dtype=d.get("wire_dtype"),
+                   groups=(tuple(StageGroup.from_dict(g) for g in groups)
+                           if groups is not None else None))
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2) + "\n"
@@ -345,5 +454,5 @@ def load_plan(path_or_dict) -> Plan:
     return Plan.load(path_or_dict)
 
 
-__all__ = ["Plan", "PlanError", "PlanTopology", "SCOPES", "STAGE_OPS",
-           "Stage", "load_plan"]
+__all__ = ["Plan", "PlanError", "PlanTopology", "RATIO_TOL", "SCOPES",
+           "STAGE_OPS", "Stage", "StageGroup", "load_plan"]

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from chainermn_tpu.planner.ir import Plan, PlanTopology, Stage
+from chainermn_tpu.planner.ir import (Plan, PlanError, PlanTopology, Stage,
+                                      StageGroup)
 
 
 def _ar(scope: str, **kw) -> Stage:
@@ -91,9 +92,118 @@ def compressed_two_dimensional(comp: dict, wire_dtype: str = "bfloat16",
                       lowering="masked-psum", wire_dtype=wire_dtype)))
 
 
+def _two_dimensional_stages(wire_dtype: Optional[str] = None,
+                            dcn_comp: Optional[dict] = None) -> tuple:
+    """The 2-D chain as stage data: RS(intra) → AR(inter) → masked-psum
+    AG(intra), ICI legs on ``wire_dtype``, the inter hop either on
+    ``wire_dtype`` too or quantized by ``dcn_comp``."""
+    inter = (Stage(op="all-reduce", scope="inter", compression=dcn_comp)
+             if dcn_comp is not None else
+             Stage(op="all-reduce", scope="inter", wire_dtype=wire_dtype))
+    return (Stage(op="reduce-scatter", scope="intra",
+                  wire_dtype=wire_dtype),
+            inter,
+            Stage(op="all-gather", scope="intra", lowering="masked-psum",
+                  wire_dtype=wire_dtype))
+
+
+#: default split-ratio sweep for striped candidates — the ICI stripe's
+#: share of the payload (the DCN stripe takes the rest).  The FlexLink
+#: sweet spot moves with the ICI:DCN bandwidth gap, so the autotuner
+#: measures the ladder instead of trusting one analytic point.
+STRIPE_RATIOS = (0.5, 0.6, 0.7, 0.8, 0.9)
+
+
+def striped_plan(ratio: float,
+                 dcn_comp: Optional[dict] = None,
+                 wire_dtype: str = "bfloat16",
+                 name: Optional[str] = None) -> Plan:
+    """A two-group striped allreduce (FlexLink direction): ``ratio`` of
+    the packed buffer rides an ICI-dominant 2-D chain (ICI legs in
+    ``wire_dtype``, inter hop in ``wire_dtype``), the remaining
+    ``1 - ratio`` rides a DCN-lean 2-D chain whose inter hop is
+    quantized by ``dcn_comp`` (int8/fp8 + error feedback — PR 8's
+    per-stage compression composing with striping).  With
+    ``dcn_comp=None`` both stripes are the plain ``wire_dtype`` chain —
+    the pure pipelining candidate, where the win is one stripe's ICI
+    legs hiding behind the other stripe's DCN hop.
+
+    The two chains are data-independent slices, so the compiler's
+    lowering lets XLA interleave them; the per-link cost model
+    (``plan_modeled_time_s``) prices the plan as max(slowest chain,
+    busiest link), which is what makes an intermediate ratio beat both
+    single-path endpoints on heterogeneous links.
+    """
+    if not (0.0 < ratio <= 1.0):
+        raise PlanError(f"stripe ratio must be in (0, 1], got {ratio}")
+    tag = f"r{int(round(ratio * 100)):02d}"
+    if dcn_comp is not None:
+        tag += f"_{dcn_comp.get('name', '?')}"
+    groups = [StageGroup(stages=_two_dimensional_stages(wire_dtype),
+                         ratio=ratio)]
+    if ratio < 1.0:
+        groups.append(StageGroup(
+            stages=_two_dimensional_stages(wire_dtype, dcn_comp=dcn_comp),
+            ratio=round(1.0 - ratio, 12)))
+    return Plan(name=name or f"striped_{tag}", packing="flat",
+                groups=tuple(groups))
+
+
+def multicast_plan(hierarchical: bool = False, root: int = 0,
+                   wire_dtype: Optional[str] = None,
+                   topology: Optional[PlanTopology] = None,
+                   name: Optional[str] = None) -> Plan:
+    """Weight-broadcast as a tuned plan: one ``multicast`` stage over
+    every data axis (flat), or the hierarchical two-stage form —
+    multicast over ICI first (each inter position learns its intra
+    root's value), then over the DCN axes (the root's inter position
+    overwrites the rest) — so the expensive one-to-many crosses the DCN
+    boundary on 1 stage of ``intra``-fanned traffic instead of a global
+    fan.  Leaf packing: serving params are arbitrary trees.  A non-zero
+    global ``root`` under the hierarchical form needs the ``topology``
+    to split into (inter, intra) coordinates."""
+    if not hierarchical:
+        return Plan(name=name or "multicast_flat", packing="leaf",
+                    stages=(Stage(op="multicast", scope="all", root=root,
+                                  wire_dtype=wire_dtype),))
+    root_inter, root_intra = 0, 0
+    if root:
+        if topology is None:
+            raise PlanError(
+                "hierarchical multicast with a non-zero root needs the "
+                "topology to split the root into (inter, intra) coords")
+        root_inter, root_intra = divmod(int(root), topology.intra_size)
+    return Plan(name=name or "multicast_hierarchical", packing="leaf",
+                stages=(Stage(op="multicast", scope="intra",
+                              root=root_intra, wire_dtype=wire_dtype),
+                        Stage(op="multicast", scope="inter",
+                              root=root_inter, wire_dtype=wire_dtype)))
+
+
+def broadcast_plans(topology: PlanTopology,
+                    wire_dtypes: tuple = ("bfloat16",)) -> List[Plan]:
+    """The broadcast/param-distribution candidate zoo for one topology:
+    flat and (on multi-axis topologies) hierarchical multicast, at full
+    precision and at each reduced wire dtype.  The serving weight path
+    (``serving/weights.broadcast_inference_params``) accepts any of
+    these through its ``plan=`` seam."""
+    out: List[Plan] = [multicast_plan()]
+    for wd in wire_dtypes:
+        out.append(multicast_plan(wire_dtype=wd,
+                                  name=f"multicast_flat_{wd}"))
+    if len(topology.axes) >= 2 and topology.inter_size > 1:
+        out.append(multicast_plan(hierarchical=True))
+        for wd in wire_dtypes:
+            out.append(multicast_plan(
+                hierarchical=True, wire_dtype=wd,
+                name=f"multicast_hierarchical_{wd}"))
+    return out
+
+
 def candidate_plans(topology: PlanTopology,
                     wire_dtypes: tuple = ("bfloat16",),
-                    dcn_compressors: tuple = DCN_COMPRESSORS) -> List[Plan]:
+                    dcn_compressors: tuple = DCN_COMPRESSORS,
+                    stripe_ratios: tuple = ()) -> List[Plan]:
     """The autotuner's search space for one topology.
 
     Always includes every fixed flavor legal on the topology (so the
@@ -104,6 +214,12 @@ def candidate_plans(topology: PlanTopology,
     on multi-axis topologies whose inter scope can carry in-wire summed
     codes, per-hop compressed variants (quantized DCN hop, reduced-wire
     ICI hops).
+
+    ``stripe_ratios`` adds two-group striped candidates at each ratio
+    (``striped_plan`` — a compressed-DCN stripe when the topology's
+    inter size can carry int8 codes, plus the uncompressed pipelining
+    stripe), so the autotuner tunes the split ratio the same way it
+    tunes wire dtypes.
     """
     multi_axis = len(topology.axes) >= 2 and topology.inter_size >= 1
     out: List[Plan] = [flavor_plan("naive"), flavor_plan("flat"),
@@ -128,21 +244,34 @@ def candidate_plans(topology: PlanTopology,
                               lowering="masked-psum"))))
     if multi_axis and topology.inter_size > 1:
         from chainermn_tpu.compression import resolve_compressor
-        for comp in dcn_compressors:
+
+        def _legal(comp: dict) -> bool:
             try:
                 resolve_compressor(dict(comp)).clip_limit(
                     topology.inter_size)
+                return True
             except ValueError:
-                continue  # too few code levels at this inter size
-            out.append(compressed_two_dimensional(dict(comp)))
+                return False  # too few code levels at this inter size
+
+        for comp in dcn_compressors:
+            if _legal(comp):
+                out.append(compressed_two_dimensional(dict(comp)))
+        stripe_comp = next((dict(c) for c in dcn_compressors
+                            if _legal(c)), None)
+        for r in stripe_ratios:
+            out.append(striped_plan(float(r)))
+            if stripe_comp is not None and float(r) < 1.0:
+                out.append(striped_plan(float(r), dcn_comp=stripe_comp))
     # De-duplicate by serialized form (xla with no wire == flat, etc.)
     seen: Dict[str, Plan] = {}
     for p in out:
-        key = repr((p.packing, p.wire_dtype,
-                    tuple(s.to_dict().items() for s in p.stages)))
-        seen.setdefault(key, p)
+        d = p.to_dict()
+        d.pop("name", None)
+        seen.setdefault(repr(d), p)
     return list(seen.values())
 
 
-__all__ = ["DCN_COMPRESSORS", "FLAVOR_NAMES", "candidate_plans",
-           "compressed_two_dimensional", "flavor_plan"]
+__all__ = ["DCN_COMPRESSORS", "FLAVOR_NAMES", "STRIPE_RATIOS",
+           "broadcast_plans", "candidate_plans",
+           "compressed_two_dimensional", "flavor_plan", "multicast_plan",
+           "striped_plan"]

@@ -136,27 +136,44 @@ def dequantize_inference_params(qparams):
 
 # -- planner multicast broadcast ----------------------------------------------
 
-def weights_multicast_plan(root: int = 0, name: str = "serving_weights"):
-    """The broadcast as a serializable planner plan: one leaf-packed
-    ``multicast`` stage over the communicator's full scope."""
-    from chainermn_tpu.planner.ir import Plan, Stage
+def weights_multicast_plan(root: int = 0, name: str = "serving_weights",
+                           hierarchical: bool = False, topology=None):
+    """The broadcast as a serializable planner plan: a leaf-packed
+    ``multicast`` chain over the communicator's full scope — one global
+    stage, or (``hierarchical=True``) the intra-then-inter two-stage
+    form that crosses the DCN boundary once per node instead of in a
+    global fan (``planner.plans.multicast_plan``; a non-zero ``root``
+    then needs the ``topology`` to split into (inter, intra) coords)."""
+    from chainermn_tpu.planner.plans import multicast_plan
 
-    return Plan(name=name, packing="leaf",
-                stages=(Stage(op="multicast", scope="all", root=root),))
+    return multicast_plan(hierarchical=hierarchical, root=root,
+                          topology=topology, name=name)
 
 
-def broadcast_inference_params(comm, params, root: int = 0):
+def broadcast_inference_params(comm, params, root: int = 0, *,
+                               plan=None):
     """Ship ``root``'s consolidated params to every device of ``comm``
     via the multicast plan's leaf-mode stage chain (NOT ``execute_plan``,
     whose gradient-mean division would scale the weights by 1/size).
     ``params`` is root's tree; returns the replicated tree (identical on
     every rank).  Quantized trees from
     :func:`quantize_inference_params` pass through — int8 codes ride the
-    wire at 1/4 the bytes.
+    wire at 1/4 the bytes.  ``plan`` overrides the default flat
+    multicast with any leaf-packed broadcast plan — e.g. a tuned entry
+    from ``planner.broadcast_plans`` (hierarchical multicast crossing
+    the DCN boundary once per node); it must deliver root's value, so
+    build it with the same ``root``.
     """
     from chainermn_tpu.planner.compiler import _run_stages_leaf
 
-    plan = weights_multicast_plan(root=root)
+    if plan is None:
+        plan = weights_multicast_plan(root=root,
+                                      topology=comm.plan_topology())
+    plan.validate()
+    if plan.packing != "leaf":
+        raise ValueError(
+            f"broadcast plan {plan.name!r} must use leaf packing "
+            f"(arbitrary param trees); got {plan.packing!r}")
     topology = comm.plan_topology()
     size = comm.size
 

@@ -311,10 +311,10 @@ class TestRecorderSurfaces:
         po.edge("end", *args)
         assert reg.get("plan_stage_seconds").count(
             plan="hier", stage="1", op="all-reduce", scope="inter",
-            link="dcn") == 1
+            link="dcn", group="-") == 1
         assert reg.get("plan_stage_bytes").value(
             plan="hier", stage="1", op="all-reduce", scope="inter",
-            link="dcn") == 4096
+            link="dcn", group="-") == 4096
         kinds = [e["kind"] for e in fr.snapshot()]
         assert kinds == ["plan_stage_begin", "plan_stage_end"]
         # the device-side gate and the host backstop pick the same shard

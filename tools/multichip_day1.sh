@@ -200,6 +200,32 @@ run 0 "$OUT/PLANNER_GATE_COMPRESSED_$ROUND.json" \
             --table '$OUT/PLAN_TABLE_COMPRESSED_$ROUND.json' \
             --out '$OUT/PLANNER_GATE_COMPRESSED_$ROUND.json'"
 
+# ---- heterogeneous link striping: sweep -> autotune -> gate -----------
+# Same pipeline again with the concurrent stage-group candidates
+# (striped_plan: plain-ICI stripe || int8-DCN stripe at swept ratios)
+# and BOTH link classes modeled (--link-gbps ici=X,dcn=Y adds
+# plan_modeled_time_s — max over per-group chain times and per-link
+# busy times — to each row; raw timings kept in us_measured).  The
+# stress rates make the modeled wire term dominate CPU-measured time so
+# a tuned split ratio can win cells here; --require-striped 2 makes the
+# gate FAIL unless striped plans beat the best single-path plan in >= 2
+# cells, and the artifact's striped.best_speedup feeds the
+# striped_allreduce_speedup budget.  On a slice, re-run WITHOUT the env
+# override and WITHOUT --link-gbps to tune ratios on measured ICI/DCN
+# (docs/collective_planner.md "Concurrent stage groups").
+run 0 "$OUT/PLANNER_GATE_STRIPED_$ROUND.json" \
+    "striped planner gate: sweep incl. concurrent ICI||DCN stage-group plans under modeled heterogeneous links, require striped wins in >= 2 cells" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_allreduce.py \
+            --sweep '$OUT/ALLREDUCE_SWEEP_STRIPED_$ROUND.json' \
+            --intra-size 4 --link-gbps ici=0.2,dcn=0.01 \
+            --stripe-ratios 0.5,0.6,0.7,0.8,0.9 --iters 10 --warmup 2 > /dev/null \
+        && $PY_TPU tools/perf_gate.py \
+            --planner '$OUT/ALLREDUCE_SWEEP_STRIPED_$ROUND.json' \
+            --table '$OUT/PLAN_TABLE_STRIPED_$ROUND.json' \
+            --require-striped 2 \
+            --out '$OUT/PLANNER_GATE_STRIPED_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

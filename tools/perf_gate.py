@@ -27,7 +27,11 @@ that invokes them goes red instead of silently recording a slower repo:
    strictly beats the best single fixed flavor in at least one
    (topology, dtype, size-bucket) cell — the "autotuning must pay for
    itself" acceptance criterion.  The comparison rows land in the
-   ``--out`` JSON artifact.
+   ``--out`` JSON artifact.  With ``--require-striped N`` the gate
+   additionally demands striped (concurrent stage group) plans beat the
+   best single-path plan in at least N cells; the artifact then carries
+   a ``striped`` block with wins / best_speedup for the
+   ``striped_allreduce_speedup`` perf budget.
 
 Wired into ``tools/multichip_day1.sh`` as the PERF_GATE and PLANNER
 legs; see docs/collective_planner.md.
@@ -144,6 +148,9 @@ def planner_gate(args):
     table, comparison = autotune_from_rows(rows)
     wins = [c for c in comparison
             if c["speedup"] is not None and c["speedup"] > 1.0]
+    striped_wins = [c for c in comparison
+                    if c.get("striped_speedup") is not None
+                    and c["striped_speedup"] > 1.0]
     for c in comparison:
         speedup = c["speedup"]
         if speedup is None:
@@ -152,12 +159,18 @@ def planner_gate(args):
                   file=sys.stderr)
             continue
         mark = "WIN " if speedup > 1.0 else "    "
+        stripe = ""
+        if c.get("striped_speedup") is not None:
+            stripe = (f" [striped beats best single "
+                      f"{c['best_single_plan']} x{c['striped_speedup']:.3f}]")
         print(f"perf_gate {mark} {c['topology']} {c['dtype']} "
               f"{c['bucket']:>9}: tuned={c['tuned_plan']} "
               f"({c['tuned_us']:.1f} us) vs best_fixed="
               f"{c['best_fixed_plan']} ({c['best_fixed_us']:.1f} us) "
-              f"speedup={speedup:.3f}", file=sys.stderr)
+              f"speedup={speedup:.3f}{stripe}", file=sys.stderr)
     ok = bool(wins)
+    if args.require_striped:
+        ok = ok and len(striped_wins) >= args.require_striped
     table.meta.update({"sweep": os.path.basename(args.planner),
                        "backend": sweep.get("backend"),
                        "n_devices": sweep.get("n_devices")})
@@ -172,17 +185,31 @@ def planner_gate(args):
                 "topology": sweep.get("topology"),
                 "cells": comparison,
                 "tuned_wins": len(wins),
+                "striped": {
+                    "wins": len(striped_wins),
+                    "best_speedup": (max(c["striped_speedup"]
+                                         for c in striped_wins)
+                                     if striped_wins else None),
+                    "required": args.require_striped,
+                },
                 "ok": ok}
     if args.out:
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2)
             f.write("\n")
     print(json.dumps({"ok": ok, "tuned_wins": len(wins),
+                      "striped_wins": len(striped_wins),
                       "cells": len(comparison)}), flush=True)
     if not ok:
-        print("perf_gate: FAIL — tuned table never beats the best fixed "
-              "flavor; autotuning is not paying for itself on this "
-              "topology", file=sys.stderr)
+        if args.require_striped and len(striped_wins) < args.require_striped:
+            print(f"perf_gate: FAIL — striped plans win only "
+                  f"{len(striped_wins)} cell(s), gate requires "
+                  f"{args.require_striped}; link striping is not paying "
+                  f"for itself on this topology", file=sys.stderr)
+        else:
+            print("perf_gate: FAIL — tuned table never beats the best "
+                  "fixed flavor; autotuning is not paying for itself on "
+                  "this topology", file=sys.stderr)
     return 0 if ok else 1
 
 
@@ -203,6 +230,12 @@ def main():
     parser.add_argument("--planner", default=None, metavar="SWEEP.json",
                         help="planner-gate mode: bench_allreduce --sweep "
                              "artifact to autotune and gate")
+    parser.add_argument("--require-striped", type=int, default=0,
+                        metavar="N",
+                        help="planner mode: additionally require striped "
+                             "plans to beat the best single-path plan in "
+                             "at least N cells (the heterogeneous-link "
+                             "striping acceptance criterion)")
     parser.add_argument("--table", default=None, metavar="TABLE.json",
                         help="planner mode: write the tuned plan table "
                              "here (load with create_communicator('auto', "
