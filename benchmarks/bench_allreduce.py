@@ -117,10 +117,35 @@ def main():
                              "candidate plans (planner.striped_plan) to "
                              "the --sweep grid; off by default so "
                              "pre-striping sweeps reproduce")
+    parser.add_argument("--replay-spans", metavar="FILE", default=None,
+                        help="instead of timing anything, feed a committed "
+                             "span/attribution dump (flight_<rank>.json, a "
+                             "JSON event list, or an event JSONL) through "
+                             "the online tuner's observation store and "
+                             "reproduce its re-tune decision offline — "
+                             "deterministic, device-free, no process "
+                             "spawn.  Emits the online_tune/v1 artifact "
+                             "tools/perf_gate.py --online-tune gates")
+    parser.add_argument("--replay-topology", default="inter:2,intra:4",
+                        metavar="KEY",
+                        help="PlanTopology key the replayed spans were "
+                             "recorded on (--replay-spans runs without a "
+                             "device mesh, so the topology is declared)")
+    parser.add_argument("--replay-table", metavar="FILE", default=None,
+                        help="baseline plan table the re-tune is compared "
+                             "against (default: empty table, i.e. the "
+                             "flat fallback plan)")
+    parser.add_argument("--replay-out", metavar="OUT.json", default=None,
+                        help="write the --replay-spans artifact here "
+                             "(default: print to stdout)")
     args = parser.parse_args()
     if args.dcn_gbps and args.link_gbps:
         parser.error("--dcn-gbps and --link-gbps are mutually exclusive "
                      "(--link-gbps ici=inf,dcn=X is the superset)")
+    if args.replay_spans:
+        # replay never touches jax/devices — dispatch before the device
+        # census below so it runs anywhere, bit-identically
+        return _replay(args)
 
     import jax
     import jax.numpy as jnp
@@ -289,6 +314,104 @@ def _time_spmd(comm, body, stacked, iters, warmup):
             jax.block_until_ready(out)
     fence(out)
     return (time.perf_counter() - t0) / iters
+
+
+def _load_events(path):
+    """Events from a committed span dump: a flight dump
+    (``{"events": [...]}``), a plain JSON event list, or an event JSONL
+    (one JSON object per line — torn final lines tolerated, same policy
+    as the metrics reader)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head in ("[", "{"):
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError:
+                doc = None
+            if isinstance(doc, list):
+                return doc
+            if isinstance(doc, dict):
+                return list(doc.get("events", []))
+            f.seek(0)
+        events = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return events
+
+
+def _replay(args):
+    """--replay-spans: reproduce an online re-tune decision offline.
+
+    Feeds a committed span dump through the SAME observation store and
+    decision path the live loop uses (``planner.online.OnlineTuner``):
+    completed ``plan_stage`` spans become observed per-link rates, the
+    candidate zoo is re-priced through ``plan_modeled_time_s`` at those
+    rates, and the artifact records whether the tuner would hot-swap and
+    at what modeled speedup.  Deterministic and device-free — the replay
+    of a degraded-DCN dump is the CI proof (``ONLINE_TUNE`` leg of
+    ``tools/multichip_day1.sh``; gated by ``perf_gate.py
+    --online-tune`` and the ``retune_speedup`` perf budget).
+    """
+    from chainermn_tpu.planner.autotune import PlanTable
+    from chainermn_tpu.planner.ir import PlanTopology
+    from chainermn_tpu.planner.online import ONLINE_TUNE_SCHEMA, OnlineTuner
+    from chainermn_tpu.planner.plans import STRIPE_RATIOS
+
+    events = _load_events(args.replay_spans)
+    topology = PlanTopology.from_key(args.replay_topology)
+    ratios = STRIPE_RATIOS if args.stripe_ratios is None else tuple(
+        float(r) for r in str(args.stripe_ratios).split(",") if r.strip())
+    fallback = _parse_link_gbps(args.link_gbps) if args.link_gbps else None
+    table = PlanTable.load(args.replay_table) if args.replay_table else None
+    tuner = OnlineTuner(topology=topology, dtype=args.dtype, table=table,
+                        stripe_ratios=ratios, fallback_gbps=fallback,
+                        min_samples=1)
+    n_spans = tuner.ingest(events)
+    regressions = [e for e in events
+                   if e.get("kind") == "attribution_regression"]
+    tuner.on_regression(regressions)
+    decision = tuner.retune()
+    doc = {
+        "schema": ONLINE_TUNE_SCHEMA,
+        "source": os.path.basename(args.replay_spans),
+        "topology": topology.key(),
+        "dtype": args.dtype,
+        "n_events": len(events),
+        "n_spans": n_spans,
+        "regression_events": len(regressions),
+        "observed_gbps": tuner.observations.observed_gbps(1),
+        "timestamp": time.time(),
+    }
+    if decision is not None:
+        doc["retune"] = {
+            "best_speedup": decision["best_speedup"],
+            "swap": decision["swap"],
+            "threshold": decision["threshold"],
+            "table_hash": decision["table_hash"],
+            "rows_merged": decision["rows_merged"],
+            "cells": decision["cells"],
+        }
+    else:
+        doc["retune"] = None
+    blob = json.dumps(doc, indent=2) + "\n"
+    if args.replay_out:
+        with open(args.replay_out, "w") as f:
+            f.write(blob)
+        best = (doc["retune"] or {}).get("best_speedup")
+        print(f"replay: {n_spans} plan-stage spans, observed "
+              f"{doc['observed_gbps']}, retune_speedup="
+              f"{best if best is not None else 'n/a'} "
+              f"-> {args.replay_out}", file=sys.stderr)
+    else:
+        print(blob, end="")
+    return doc
 
 
 def _traced(args):

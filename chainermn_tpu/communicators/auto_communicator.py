@@ -47,6 +47,20 @@ class AutoCommunicator(MeshCommunicator):
         else:
             self.plan_table = PlanTable.load(plan_table)
 
+    def swap_plan_table(self, plan_table: Union[dict, PlanTable]) -> None:
+        """Hot-swap the plan table (the online tuner's step-boundary
+        apply).  Selection is trace-time, so the swap is the assignment
+        plus dropping this communicator's cached SPMD programs — the
+        next dispatch retraces and ``plan_for`` re-selects against the
+        new table.  Callers holding their own ``jax.jit`` step (e.g.
+        ``make_train_step``'s) must drop that cache too
+        (``step_fn.clear_cache()``); ``MetricsReport`` does both."""
+        self.plan_table = plan_table if isinstance(plan_table, PlanTable) \
+            else PlanTable.from_dict(plan_table)
+        cache = getattr(self, "_jit_cache", None)
+        if cache is not None:
+            cache.clear()
+
     def plan(self) -> Plan:
         """The fallback plan (table-independent); per-message selection
         happens in :meth:`plan_for`."""

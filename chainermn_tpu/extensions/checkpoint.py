@@ -33,6 +33,11 @@ _FSDP_META_KEY = "__fsdp_meta__"
 # (compressor specs + EF version): a resume under a different compressor
 # would silently mis-scale the restored residuals.
 _COMPRESSION_META_KEY = "__compression_meta__"
+# Content hash + swap step of a hot-swapped plan table (the online
+# tuner's step-boundary re-tune, planner/online.py): a resume that would
+# silently execute a DIFFERENT plan than the run that saved must refuse
+# — plan provenance is part of the run's performance contract.
+_PLAN_TABLE_META_KEY = "__plan_table_meta__"
 
 
 def _flatten_state(state) -> Tuple[dict, Any]:
@@ -95,6 +100,12 @@ class _MultiNodeCheckpointer:
                 # bucket compressors or a compressed optimizer)
                 arrays[_COMPRESSION_META_KEY] = np.array(
                     json.dumps(clayout))
+            from chainermn_tpu.planner.online import active_plan_table_meta
+            tmeta = active_plan_table_meta()
+            if tmeta is not None:
+                # pin the hot-swapped plan table's hash so resume can
+                # refuse a silently different plan (planner/online.py)
+                arrays[_PLAN_TABLE_META_KEY] = np.array(json.dumps(tmeta))
             # np.savez appends .npz when missing, so the temp name must
             # end in it
             tmp = self._file(iteration) + ".tmp.npz"
@@ -201,6 +212,38 @@ class _MultiNodeCheckpointer:
                 f"and delayed scales are bound to the compressor spec; "
                 f"pass the identical compression config, or restart "
                 f"fresh under the new one")
+        # Plan-table pin: a checkpoint saved after an online hot-swap is
+        # bound to the swapped table's content hash — resuming without
+        # it (or with a different one) would silently execute different
+        # plans than the run that saved (ADVICE-r5 posture: fail loudly,
+        # name the fix).
+        from chainermn_tpu.planner.online import active_plan_table_meta
+        raw_t = arrays.pop(_PLAN_TABLE_META_KEY, None)
+        saved_t = json.loads(str(raw_t)) if raw_t is not None else None
+        live_t = active_plan_table_meta()
+        if saved_t is not None and live_t is None:
+            raise ValueError(
+                f"checkpoint {where} was saved after an online plan-table "
+                f"hot-swap (table_hash={saved_t['table_hash']}, swap step "
+                f"{saved_t['swap_step']}) but no active plan table is "
+                f"registered in this process — reload the swapped table "
+                f"(PlanTable.load) and register it with "
+                f"planner.online.set_active_plan_table before resuming, "
+                f"or, to deliberately discard the tuned plans, clear the "
+                f"pin by resuming into a fresh run without the sidecar "
+                f"(re-save after planner.online.clear_active_plan_table)")
+        if saved_t is not None and \
+                saved_t["table_hash"] != live_t["table_hash"]:
+            raise ValueError(
+                f"checkpoint {where} pins plan table "
+                f"{saved_t['table_hash']} (hot-swapped at step "
+                f"{saved_t['swap_step']}) but the active table is "
+                f"{live_t['table_hash']} — the run would silently execute "
+                f"different collective plans than the one that saved; "
+                f"register the matching table via "
+                f"planner.online.set_active_plan_table(PlanTable.load(...)) "
+                f"or re-tune from scratch with "
+                f"planner.online.clear_active_plan_table()")
         # Generic leaf-shape validation (also catches a legacy FSDP
         # checkpoint without the sidecar, or a plain checkpoint resumed
         # into an FSDP target): every mismatch beats a cryptic unflatten

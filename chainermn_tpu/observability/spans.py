@@ -147,6 +147,22 @@ def pair_events(events: List[dict], rank: int = 0) -> List[Span]:
     return out
 
 
+def stage_link_timings(events: List[dict]) -> List[tuple]:
+    """Per-stage link timings from raw flight events: one
+    ``(link, nbytes, dur_s)`` tuple per COMPLETED ``plan_stage`` span
+    with a link class and a positive payload.  This is the export the
+    online tuner's observation window eats (``planner.online``) — the
+    per-link transfer evidence, stripped of plan/step structure."""
+    out = []
+    for sp in pair_events(list(events)):
+        if sp.kind != "plan_stage":
+            continue
+        link, nbytes = sp.meta.get("link"), sp.meta.get("nbytes")
+        if link and nbytes:
+            out.append((str(link), int(nbytes), sp.dur_s))
+    return out
+
+
 def step_windows(events: List[dict], rank: int = 0) -> List[Span]:
     """Step root spans.  ``step`` events are END-stamped (the updater
     records ``dur_s`` at step completion), so each window is
@@ -353,5 +369,6 @@ __all__ = [
     "get_plan_obs",
     "pair_events",
     "phase_spans",
+    "stage_link_timings",
     "step_windows",
 ]

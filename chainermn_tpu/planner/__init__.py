@@ -35,6 +35,18 @@ from chainermn_tpu.planner.compiler import (
     plan_wire_bytes,
     plan_wire_dtypes,
 )
+from chainermn_tpu.planner.online import (
+    LinkObservations,
+    ONLINE_TUNE_SCHEMA,
+    OnlineTuner,
+    active_plan_table_meta,
+    clear_active_plan_table,
+    get_active_plan_table,
+    plan_table_hash,
+    recommend_prefetch_depth,
+    set_active_plan_table,
+    synthesize_sweep_rows,
+)
 from chainermn_tpu.planner.ir import (
     Plan,
     PlanError,
@@ -60,6 +72,9 @@ __all__ = [
     "FIXED_PLAN_NAMES",
     "FLAVOR_NAMES",
     "LINK_CLASS",
+    "LinkObservations",
+    "ONLINE_TUNE_SCHEMA",
+    "OnlineTuner",
     "PLAN_TABLE_SCHEMA",
     "Plan",
     "PlanError",
@@ -71,11 +86,14 @@ __all__ = [
     "SWEEP_SCHEMA",
     "Stage",
     "StageGroup",
+    "active_plan_table_meta",
     "autotune_from_rows",
     "broadcast_plans",
+    "clear_active_plan_table",
     "candidate_plans",
     "execute_plan",
     "flavor_plan",
+    "get_active_plan_table",
     "init_plan_compression_states",
     "load_plan",
     "multicast_plan",
@@ -87,8 +105,12 @@ __all__ = [
     "plan_modeled_time_s",
     "plan_stage_lengths",
     "plan_wire_bytes",
+    "plan_table_hash",
     "plan_wire_dtypes",
+    "recommend_prefetch_depth",
+    "set_active_plan_table",
     "size_bucket",
     "striped_plan",
+    "synthesize_sweep_rows",
     "validate_sweep_rows",
 ]

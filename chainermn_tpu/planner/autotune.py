@@ -166,15 +166,32 @@ def autotune_from_rows(rows: List[dict]):
     requires on ``--require-striped`` cells.  Within a cell a plan's
     time is the MEAN over the sweep's sizes in that bucket, so a plan
     must win across the bucket, not on one lucky rung.
+
+    Colliding rows — two sweeps (e.g. concatenated sweep files, or an
+    online re-tune folded over an offline table) timing the SAME
+    (topology, dtype, bytes, plan) rung — are mean-merged first, so a
+    duplicated rung cannot double-weight the bucket mean; the collision
+    count is surfaced as ``rows_merged`` in the table artifact's
+    ``meta`` (0 for a clean single sweep).
     """
     validate_sweep_rows(rows)
-    # cell -> plan name -> [(us, plan_spec)]
-    cells: Dict[tuple, Dict[str, List[tuple]]] = {}
+    # dedup pass: (cell, plan, bytes) -> [(us, plan_spec)]; a rung timed
+    # more than once collapses to its mean before the bucket mean
+    rungs: Dict[tuple, List[tuple]] = {}
     for r in rows:
         cell = (r["topology"], str(r["dtype"]), size_bucket(int(r["bytes"])))
-        cells.setdefault(cell, {}).setdefault(r["plan"], []).append(
+        rungs.setdefault((cell, r["plan"], int(r["bytes"])), []).append(
             (float(r["us"]), r.get("plan_spec")))
-    table = PlanTable(meta={"schema_in": SWEEP_SCHEMA, "rows": len(rows)})
+    rows_merged = sum(len(samples) - 1 for samples in rungs.values())
+    # cell -> plan name -> [(us, plan_spec)] with one sample per rung
+    cells: Dict[tuple, Dict[str, List[tuple]]] = {}
+    for (cell, plan_name, _nbytes), samples in rungs.items():
+        us = sum(u for u, _ in samples) / len(samples)
+        spec = next((s for _, s in samples if s is not None), None)
+        cells.setdefault(cell, {}).setdefault(plan_name, []).append(
+            (us, spec))
+    table = PlanTable(meta={"schema_in": SWEEP_SCHEMA, "rows": len(rows),
+                            "rows_merged": rows_merged})
     comparison: List[dict] = []
     for cell, by_plan in sorted(cells.items()):
         tkey, dtype, bucket = cell

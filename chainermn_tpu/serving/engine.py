@@ -181,6 +181,50 @@ class InferenceEngine:
                                         "wall time per engine step"),
             }
         self._fr = _flight.get_flight_recorder()
+        # last plan-table content hash this engine saw (online-tuner
+        # hot-swaps ride the per-step plan broadcast — see step())
+        self._plan_table_hash = None
+
+    # -- online-tuner plan-table pickup --------------------------------------
+    def _attach_plan_table(self, plan):
+        """Rank-0 side: when the online tuner hot-swapped a plan table
+        since this engine last broadcast one, piggyback the table on the
+        step's plan envelope so every controller picks it up on the SAME
+        serving step (the scheduler bcast is already the engine's
+        lockstep decision channel)."""
+        from chainermn_tpu.planner.online import (active_plan_table_meta,
+                                                  get_active_plan_table)
+        meta = active_plan_table_meta()
+        if meta is not None and meta["table_hash"] != self._plan_table_hash:
+            table = get_active_plan_table()
+            plan = dict(plan, plan_table={
+                "table_hash": meta["table_hash"],
+                "swap_step": meta["swap_step"],
+                "table": table.to_dict()})
+        return plan
+
+    def _pickup_plan_table(self, plan):
+        """Every rank: strip a piggybacked plan table off the envelope,
+        register it as this process's active table (sidecar pin +
+        ``AutoCommunicator`` swaps read it), and mark the pickup with a
+        flight event."""
+        if not isinstance(plan, dict) or "plan_table" not in plan:
+            return plan
+        from chainermn_tpu.planner.autotune import PlanTable
+        from chainermn_tpu.planner.online import set_active_plan_table
+        entry = plan.pop("plan_table")
+        if entry["table_hash"] != self._plan_table_hash:
+            self._plan_table_hash = entry["table_hash"]
+            if self.plane.rank != 0:
+                set_active_plan_table(
+                    PlanTable.from_dict(entry["table"]),
+                    step=entry.get("swap_step"))
+            if self._fr is not None:
+                self._fr.record("plan_table_swap_pickup",
+                                step=self._step_idx,
+                                table_hash=entry["table_hash"],
+                                swap_step=entry.get("swap_step"))
+        return plan
 
     # -- forward -------------------------------------------------------------
     def _build_forward(self):
@@ -242,7 +286,8 @@ class InferenceEngine:
         t0 = time.perf_counter()
         sched = self.scheduler
         if self.plane.size > 1:
-            plan = sched.build_plan() if self.plane.rank == 0 else None
+            plan = self._attach_plan_table(sched.build_plan()) \
+                if self.plane.rank == 0 else None
             btok = None
             if self._fr is not None:
                 btok = self._fr.span_begin("object", "serving_plan_bcast",
@@ -251,7 +296,8 @@ class InferenceEngine:
             if self._fr is not None:
                 self._fr.span_end(btok)
         else:
-            plan = sched.build_plan()
+            plan = self._attach_plan_table(sched.build_plan())
+        plan = self._pickup_plan_table(plan)
         tok = None
         if self._fr is not None:
             tok = self._fr.span_begin(

@@ -273,6 +273,51 @@ class TestEngineParity:
         assert eng.scheduler.allocator.num_free == 16
         assert (eng.scheduler.page_table == 16).all()
 
+    def test_online_swapped_plan_table_rides_the_step_plan(self, tiny):
+        """The online tuner's hot-swapped table piggybacks on the
+        engine's per-step scheduler-plan envelope: one pickup per swap
+        (content-hash gated), then the attach side goes quiet."""
+        from chainermn_tpu.planner import (PlanTable, PlanTopology,
+                                           flavor_plan)
+        from chainermn_tpu.planner.online import (
+            active_plan_table_meta, clear_active_plan_table,
+            plan_table_hash, set_active_plan_table)
+
+        clear_active_plan_table()
+        try:
+            model, params = tiny
+            cfg = ServingConfig(page_size=4, num_pages=16, max_seqs=2,
+                                chunk_tokens=4, max_pages_per_seq=4)
+            eng = InferenceEngine(model, params, cfg)
+            eng.submit(_prompts((4,))[0], max_new_tokens=2)
+            topo = PlanTopology(axes=(("inter", 2), ("intra", 4)))
+            table = PlanTable()
+            table.put(topo, "float32", "<=1MiB",
+                      flavor_plan("hierarchical"))
+            set_active_plan_table(table, step=7)
+            eng.step()
+            assert eng._plan_table_hash == plan_table_hash(table)
+            # picked up once: the next attach is a no-op
+            assert "plan_table" not in eng._attach_plan_table(
+                {"admit": [], "retire": []})
+            eng.run_until_idle()   # and the engine still drains fine
+
+            # the receiving-controller side: a piggybacked envelope
+            # registers the table as this process's active pin
+            env = dict({"admit": [], "retire": []},
+                       plan_table={"table_hash": plan_table_hash(table),
+                                   "swap_step": 7,
+                                   "table": table.to_dict()})
+            clear_active_plan_table()
+            eng._plan_table_hash = None
+            eng.plane = type("P", (), {"rank": 1, "size": 2})()
+            out = eng._pickup_plan_table(env)
+            assert "plan_table" not in out
+            assert active_plan_table_meta() == {
+                "table_hash": plan_table_hash(table), "swap_step": 7}
+        finally:
+            clear_active_plan_table()
+
     def test_continuous_needs_fewer_steps_than_static(self, tiny):
         """The continuous-batching win, in steps (the wall-clock version
         is benchmarks/bench_serving.py): with staggered lengths, refilled

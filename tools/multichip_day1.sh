@@ -226,6 +226,27 @@ run 0 "$OUT/PLANNER_GATE_STRIPED_$ROUND.json" \
             --require-striped 2 \
             --out '$OUT/PLANNER_GATE_STRIPED_$ROUND.json'"
 
+# ---- online autotuning: replay degraded-link spans -> retune gate -----
+# Attribution-closed loop, offline leg: feed the committed degraded-DCN
+# span dump (healthy ~16 GB/s ICI stage timings, ~0.5 GB/s DCN stage
+# timings, plus the attribution_regression events that arm the tuner)
+# through the OnlineTuner's observation store.  The tuner recovers the
+# per-link GB/s from the plan_stage spans, re-prices the candidate zoo
+# through plan_modeled_time_s at the observed rates, and must decide to
+# hot-swap with best_speedup >= 1.05 over the previously active plan.
+# Deterministic and device-free (no mesh, no 2-process spawn); the
+# artifact's retune.best_speedup feeds the retune_speedup budget.  The
+# live loop (MetricsReport online_tune=True) is exercised by
+# tests/test_online_tune.py's 2-process swap test.
+run 0 "$OUT/ONLINE_TUNE_$ROUND.json" \
+    "online-tune gate: replay committed degraded-DCN span dump through the OnlineTuner, require a profitable (>=1.05x) plan-table retune decision" -- \
+    bash -c "$PY_TPU benchmarks/bench_allreduce.py \
+            --replay-spans tests/data/degraded_dcn_spans.json \
+            --replay-topology inter:2,intra:4 \
+            --replay-out '$OUT/ONLINE_TUNE_$ROUND.json' \
+        && $PY_TPU tools/perf_gate.py \
+            --online-tune '$OUT/ONLINE_TUNE_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

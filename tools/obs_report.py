@@ -309,11 +309,52 @@ def _attr_row(label: str, a: dict) -> List[str]:
 _ATTR_HEADERS = (["step", "total"] + list(_BUCKET_COLS) + ["sum"])
 
 
+def _plan_table_lane(records: List[dict]) -> List[str]:
+    """Plan-table lane: the online tuner's ``plan_table_state`` snapshot
+    (current tuned plan per cell) plus its ``plan_table_swap`` decisions
+    (last swap step, modeled speedup, the regression evidence that armed
+    the retune)."""
+    parts = []
+    states = [r for r in records if r.get("kind") == "plan_table_state"]
+    if states:
+        st = states[-1]
+        rows = [[c.get("topology", "?"), c.get("dtype", "?"),
+                 c.get("bucket", "?"), c.get("plan", "?"),
+                 "yes" if c.get("striped") else ""]
+                for c in st.get("cells", [])]
+        gbps = st.get("observed_gbps") or {}
+        head = (f"plan table (online tuner, it{st.get('iteration', '?')}): "
+                f"hash={st.get('table_hash', '?')} "
+                f"last_swap_step={st.get('last_swap_step', '-')} "
+                f"observed_gbps="
+                + ",".join(f"{k}={v:.3g}" for k, v in sorted(gbps.items())))
+        if rows:
+            parts.append(head + "\n" + _table(
+                ["topology", "dtype", "bucket", "plan", "striped"], rows))
+        else:
+            parts.append(head + "\n(no tuned cells yet)")
+    swaps = [r for r in records if r.get("kind") == "plan_table_swap"]
+    if swaps:
+        rows = [[f"it{s.get('iteration', s.get('step', '?'))}",
+                 str(s.get("table_hash", "?")),
+                 (f"{s.get('best_speedup'):.3f}x"
+                  if s.get("best_speedup") is not None else "-"),
+                 "; ".join(
+                     f"{e.get('bucket', '?')} x{e.get('ratio', 0):.1f} "
+                     f"@it{e.get('iteration', '?')}"
+                     for e in (s.get("evidence") or [])[-2:]) or "-"]
+                for s in swaps]
+        parts.append("plan-table swaps (step-boundary hot-swaps)\n"
+                     + _table(["step", "new table", "speedup",
+                               "evidence (last regressions)"], rows))
+    return parts
+
+
 def attribution_section(records: List[dict]) -> str:
     """Attribution lane (metrics mode): the ``step_attribution`` records
     the MetricsReport extension appends per emit — one bucket
     decomposition row each — plus the online watch's ``attribution_*``
-    regression counters."""
+    regression counters and the online tuner's plan-table lane."""
     reps = [r for r in records if r.get("kind") == "step_attribution"]
     parts = []
     if reps:
@@ -329,6 +370,7 @@ def attribution_section(records: List[dict]) -> str:
     if regs:
         parts.append("attribution regressions (rolling-baseline watch)\n"
                      + _table(["bucket", "count"], sorted(regs)))
+    parts.extend(_plan_table_lane(records))
     if not parts:
         return ("attribution: no step_attribution records or "
                 "attribution_* metrics (enable observability and the "

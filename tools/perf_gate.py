@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """perf_gate — fail loudly when a tracked benchmark regresses.
 
-Two modes, both exit nonzero on a gate failure so the runbook/CI leg
+Three modes, all exit nonzero on a gate failure so the runbook/CI leg
 that invokes them goes red instead of silently recording a slower repo:
 
 1. Budget check (default)::
@@ -33,8 +33,18 @@ that invokes them goes red instead of silently recording a slower repo:
    a ``striped`` block with wins / best_speedup for the
    ``striped_allreduce_speedup`` perf budget.
 
-Wired into ``tools/multichip_day1.sh`` as the PERF_GATE and PLANNER
-legs; see docs/collective_planner.md.
+3. Online-tune gate::
+
+       python tools/perf_gate.py --online-tune ONLINE_TUNE.json
+
+   Consumes a ``bench_allreduce --replay-spans`` artifact (schema
+   ``online_tune/v1``) — the online tuner replaying a committed
+   degraded-link span dump — and PASSES only if the tuner decided to
+   swap with ``retune.best_speedup`` at or above ``--retune-threshold``
+   (default 1.05) and pinned a ``table_hash``.
+
+Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER and
+ONLINE_TUNE legs; see docs/collective_planner.md.
 """
 
 import argparse
@@ -47,6 +57,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BUDGETS_SCHEMA = "perf_budgets/v1"
 PLANNER_GATE_SCHEMA = "planner_gate/v1"
+ONLINE_TUNE_SCHEMA = "online_tune/v1"
 
 
 def _dig(doc, dotted):
@@ -213,6 +224,64 @@ def planner_gate(args):
     return 0 if ok else 1
 
 
+def online_tune_gate(args):
+    """Gate a ``bench_allreduce --replay-spans`` artifact: the online
+    tuner replaying the committed degraded-link span dump must decide to
+    swap, with a modeled retune speedup at or above ``--retune-threshold``
+    — the "re-tuning must pay for itself" acceptance criterion for the
+    attribution-closed loop."""
+    with open(args.online_tune) as f:
+        doc = json.load(f)
+    if doc.get("schema") != ONLINE_TUNE_SCHEMA:
+        print(f"perf_gate: unsupported online-tune schema "
+              f"{doc.get('schema')!r} (want {ONLINE_TUNE_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    threshold = float(args.retune_threshold)
+    retune = doc.get("retune")
+    problems = []
+    if not isinstance(retune, dict):
+        problems.append("no retune decision in artifact (tuner saw no "
+                        "observations?)")
+        retune = {}
+    best = retune.get("best_speedup")
+    if best is None:
+        problems.append("retune.best_speedup missing")
+    elif float(best) < threshold:
+        problems.append(f"retune.best_speedup {float(best):.3f} below "
+                        f"gate threshold {threshold}")
+    if not retune.get("swap"):
+        problems.append("tuner declined to swap (retune.swap falsy)")
+    if not retune.get("table_hash"):
+        problems.append("retune.table_hash missing — swapped table "
+                        "would not be pinnable in checkpoint sidecars")
+    for c in retune.get("cells", []):
+        sp = c.get("speedup")
+        sp_s = f"x{sp:.3f}" if sp is not None else "(no speedup)"
+        print(f"perf_gate      {c.get('topology')} {c.get('dtype')} "
+              f"{str(c.get('bucket')):>9}: {c.get('old_plan')} -> "
+              f"{c.get('new_plan')} {sp_s}", file=sys.stderr)
+    ok = not problems
+    report = {"schema": ONLINE_TUNE_SCHEMA + "+gate",
+              "artifact": os.path.basename(args.online_tune),
+              "threshold": threshold,
+              "best_speedup": best,
+              "swap": bool(retune.get("swap")),
+              "table_hash": retune.get("table_hash"),
+              "problems": problems,
+              "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok, "best_speedup": best,
+                      "threshold": threshold}), flush=True)
+    if not ok:
+        for p in problems:
+            print(f"perf_gate: FAIL — {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--budgets", default=None, metavar="BUDGETS.json",
@@ -240,13 +309,26 @@ def main():
                         help="planner mode: write the tuned plan table "
                              "here (load with create_communicator('auto', "
                              "plan_table=...))")
+    parser.add_argument("--online-tune", default=None,
+                        metavar="ONLINE_TUNE.json",
+                        help="online-tune gate mode: bench_allreduce "
+                             "--replay-spans artifact (schema "
+                             f"{ONLINE_TUNE_SCHEMA}) that must show a "
+                             "profitable retune decision")
+    parser.add_argument("--retune-threshold", type=float, default=1.05,
+                        help="online-tune mode: minimum modeled "
+                             "retune.best_speedup to pass (default 1.05)")
     parser.add_argument("--out", default=None, metavar="OUT.json",
                         help="write the gate report/artifact JSON here")
     args = parser.parse_args()
-    if bool(args.budgets) == bool(args.planner):
-        parser.error("pass exactly one of --budgets or --planner")
+    modes = [bool(args.budgets), bool(args.planner), bool(args.online_tune)]
+    if sum(modes) != 1:
+        parser.error(
+            "pass exactly one of --budgets, --planner, or --online-tune")
     if args.planner:
         return planner_gate(args)
+    if args.online_tune:
+        return online_tune_gate(args)
     return check_budgets(args)
 
 
