@@ -249,19 +249,102 @@ def _serving_decode_target(tp: int = 2):
     return eng._fwd, args
 
 
+def _serving_spec_target(tp: int = 2):
+    """The serving engine's fused draft+verify speculative step at toy
+    size, tensor-parallel over 2 devices.  Self-draft (the 1-layer toy
+    is its own draft): the schedule theorem — k greedy draft micro-steps
+    plus one target verify pass, all Megatron psums over ``"tp"`` inside
+    ONE jitted program — is invariant to which weights the draft loads.
+    """
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import InferenceEngine, ServingConfig
+
+    model = TransformerLM(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          max_len=64, attention_impl="xla")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+    cfg = ServingConfig(page_size=4, num_pages=8, max_seqs=2,
+                        chunk_tokens=4, max_pages_per_seq=4, tp_size=tp,
+                        spec_k=2)
+    eng = InferenceEngine(model, params, cfg,
+                          draft_model=model, draft_params=params)
+    eng.submit([1, 2, 3], max_new_tokens=4)
+    eng.scheduler.apply_plan(eng.scheduler.build_plan())
+    batch = eng.scheduler.step_batch()
+    args = (eng._params, eng._dparams, eng._ck, eng._cv,
+            eng._dck, eng._dcv,
+            jnp.asarray(batch["page_table"]),
+            jnp.asarray(batch["tokens"]), jnp.asarray(batch["pos0"]),
+            jnp.asarray(batch["n_new"]), jnp.asarray(batch["decode"]),
+            jnp.asarray(batch["prev"]))
+    return eng._fwd_spec, args
+
+
 def lint_serving_decode(rules: Optional[Sequence[str]] = None,
                         hlo: bool = True) -> List[LintReport]:
-    """One report for the serving decode step (tp=2).  Lockstep serving
-    has the same SPMD obligation as training — every controller must
-    trace the identical schedule from the broadcast plan — so the
-    schedule-desync variants run the builder twice, exactly as a rank
+    """Two reports for the serving forward programs (tp=2): the plain
+    fused prefill+decode step and the fused draft+verify speculative
+    step.  Lockstep serving has the same SPMD obligation as training —
+    every controller must trace the identical schedule from the
+    broadcast plan (for the spec step: including the accept/reject
+    computation, whose decisions ride that plan's envelope) — so the
+    schedule-desync variants run each builder twice, exactly as a rank
     pair would.  No communicator object is in play (the engine drives
     shard_map directly), so the comm-bound rules report as skipped."""
     step, args = _serving_decode_target()
-    return [lint_step(
+    reports = [lint_step(
         step, *args,
         name="serving/decode[tp2]",
         variants={"rank0": (step,) + args, "rank1": (step,) + args},
+        hlo=hlo, rules=rules, raise_on_error=False)]
+    spec_step, spec_args = _serving_spec_target()
+    reports.append(lint_step(
+        spec_step, *spec_args,
+        name="serving/decode[tp2,spec]",
+        variants={"rank0": (spec_step,) + spec_args,
+                  "rank1": (spec_step,) + spec_args},
+        hlo=hlo, rules=rules, raise_on_error=False))
+    return reports
+
+
+def _serving_weights_target():
+    """The router's multicast weight-distribution program: the per-leaf
+    staged broadcast (``planner.compiler._run_stages_leaf``) the fleet
+    replicates params through, compiled on the flat 8-way communicator,
+    with the plan IR as the census spec."""
+    import chainermn_tpu
+    from chainermn_tpu.planner.compiler import _run_stages_leaf
+    from chainermn_tpu.serving import weights_multicast_plan
+
+    comm = chainermn_tpu.create_communicator("flat")
+    topo = comm.plan_topology()
+    plan = weights_multicast_plan(root=0, topology=topo,
+                                  name="serving_weights")
+    leaf = jnp.zeros((comm.size, 64), jnp.float32)
+
+    def program(stacked):
+        return _run_stages_leaf(plan, topo, stacked)
+
+    def census_hlo():
+        return comm.compiled_hlo(program, leaf)
+
+    fn = comm._spmd_program(program, jit=True)
+    return comm, plan, fn, ((leaf,),), census_hlo
+
+
+def lint_serving_weights(rules: Optional[Sequence[str]] = None,
+                         hlo: bool = True) -> List[LintReport]:
+    """One report for the fleet weight-distribution multicast.  The
+    census here is NOT the training allreduce: the ``census=`` callable
+    compiles the router's own broadcast program and census-drift holds
+    its collective decomposition to ``plan_census_kinds`` of the
+    multicast plan — params must reach every replica through the plan's
+    ONE masked-psum stage chain, never a fan of point-to-point sends."""
+    comm, plan, fn, args, census_hlo = _serving_weights_target()
+    return [lint_step(
+        fn, *args,
+        name="serving/weights[multicast]",
+        comm=comm, plan=plan, census=census_hlo,
+        variants={"rank0": (fn,) + args, "rank1": (fn,) + args},
         hlo=hlo, rules=rules, raise_on_error=False)]
 
 
@@ -288,9 +371,16 @@ ENTRY_POINTS: Dict[str, dict] = {
     "serving/decode": {
         "fn": lint_serving_decode,
         "flavors": None,
-        "help": "serving engine fused prefill+decode forward, tp=2 "
-                "Megatron shard_map (schedule, captured-constant, "
-                "async rules)",
+        "help": "serving engine fused forwards, tp=2 Megatron shard_map: "
+                "plain prefill+decode AND the draft+verify speculative "
+                "step (schedule, captured-constant, async rules)",
+    },
+    "serving/weights": {
+        "fn": lint_serving_weights,
+        "flavors": None,
+        "help": "fleet weight-distribution multicast: census-drift holds "
+                "the compiled broadcast program to the multicast plan IR "
+                "(plus schedule/async rules)",
     },
 }
 
@@ -314,4 +404,4 @@ def lint_entry_point(name: str, flavors: Optional[Sequence[str]] = None,
 
 __all__ = ["ENTRY_POINTS", "MNIST_FLAVORS", "lint_entry_point",
            "lint_long_context", "lint_mnist", "lint_resnet_fused",
-           "lint_serving_decode"]
+           "lint_serving_decode", "lint_serving_weights"]

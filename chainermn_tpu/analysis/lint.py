@@ -184,10 +184,33 @@ class LintContext:
 
     @property
     def census_schedule(self) -> Optional[CollectiveSchedule]:
+        """The compiled program census-drift checks against the declared
+        spec.  ``census=True`` compiles the communicator's own
+        ``allreduce_grad`` (the training seam); ``census=<hlo text>``
+        audits that HLO directly; ``census=<callable>`` is invoked lazily
+        (no args) to produce the HLO — the serving/router entry points
+        use this to put their OWN compiled program (fused decode step,
+        multicast weight distribution) under the same drift check."""
         def build():
             if not self.census:
                 self.unavailable["census_schedule"] = "census=False"
                 return None
+            if callable(self.census):
+                try:
+                    text = self.census()
+                except Exception as e:  # noqa: BLE001 — probe, not crash
+                    self.unavailable["census_schedule"] = \
+                        f"census probe failed: {e}"
+                    return None
+                if not isinstance(text, str):
+                    self.unavailable["census_schedule"] = \
+                        (f"census callable returned "
+                         f"{type(text).__name__}, want HLO text")
+                    return None
+                return schedule_from_hlo(text, label=f"{self.name}:census")
+            if isinstance(self.census, str):
+                return schedule_from_hlo(self.census,
+                                         label=f"{self.name}:census")
             if self.comm is None:
                 self.unavailable["census_schedule"] = "no communicator given"
                 return None
@@ -313,7 +336,7 @@ def build_grad_probe(comm, loss, loss_args, label: str = "") \
 def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
               plan=None, loss=None, loss_args=None, donate_argnums=(),
               fsdp_meta=None, fsdp_state=None, variants=None,
-              census: bool = False, hlo: bool = True,
+              census=False, hlo: bool = True,
               max_const_bytes: int = DEFAULT_MAX_BYTES,
               rules: Optional[Sequence[str]] = None,
               raise_on_error: bool = True, name: str = "",

@@ -12,8 +12,10 @@ int8 weight quantization, multicast broadcast, TP slicing.  See
 from chainermn_tpu.serving.engine import (Completion, InferenceEngine,
                                           ServingConfig, StepResult)
 from chainermn_tpu.serving.kv_cache import (KvCache, PageAllocator,
-                                            gather_kv, init_kv_cache,
+                                            PrefixCache, gather_kv,
+                                            init_kv_cache,
                                             paged_attention, write_kv)
+from chainermn_tpu.serving.router import ReplicaStatus, Router
 from chainermn_tpu.serving.scheduler import AdmissionScheduler, Request
 from chainermn_tpu.serving.weights import (broadcast_inference_params,
                                            dequantize_inference_params,
@@ -28,7 +30,10 @@ __all__ = [
     "InferenceEngine",
     "KvCache",
     "PageAllocator",
+    "PrefixCache",
+    "ReplicaStatus",
     "Request",
+    "Router",
     "ServingConfig",
     "StepResult",
     "broadcast_inference_params",

@@ -55,6 +55,8 @@ class ServingConfig:
     tp_size: int = 1              # tensor-parallel ways
     cache_dtype: Any = jnp.float32
     keep_logits: bool = False     # stash last-position logits per step
+    prefix_cache: bool = False    # copy-on-write prompt-prefix sharing
+    spec_k: int = 0               # draft tokens per decode step (0 = off)
 
 
 @dataclasses.dataclass
@@ -82,6 +84,7 @@ class StepResult:
     ran_forward: bool
     last_logits: Optional[np.ndarray] = None   # [B, vocab] (keep_logits)
     n_new: Optional[np.ndarray] = None
+    spec: Optional[dict] = None    # rows/proposed/accepted/out_tokens
 
 
 class InferenceEngine:
@@ -89,7 +92,7 @@ class InferenceEngine:
     (or :meth:`run_until_idle` on a single controller)."""
 
     def __init__(self, model, params, config: ServingConfig, *,
-                 plane=None):
+                 plane=None, draft_model=None, draft_params=None):
         from chainermn_tpu.observability import flight_recorder as _flight
         from chainermn_tpu.observability.registry import (enabled,
                                                           get_registry)
@@ -102,17 +105,34 @@ class InferenceEngine:
         n_kv = model.n_kv_heads or model.n_heads
         head_dim = model.d_model // model.n_heads
         max_ctx = cfg.max_pages_per_seq * cfg.page_size
-        if max_ctx > model.max_len:
+        if max_ctx + cfg.spec_k > model.max_len:
             raise ValueError(
                 f"cache reach ({cfg.max_pages_per_seq} pages x "
-                f"{cfg.page_size}) exceeds the model's max_len "
-                f"({model.max_len})")
+                f"{cfg.page_size}) plus spec_k ({cfg.spec_k}) exceeds "
+                f"the model's max_len ({model.max_len})")
+        if cfg.spec_k:
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "spec_k > 0 requires a draft_model and draft_params")
+            if cfg.chunk_tokens < cfg.spec_k + 1:
+                raise ValueError(
+                    f"spec_k ({cfg.spec_k}) needs chunk_tokens >= "
+                    f"spec_k + 1 (the verify pass scores k+1 positions "
+                    f"in the [B, S] step shape), got {cfg.chunk_tokens}")
+            if draft_model.vocab != model.vocab:
+                raise ValueError(
+                    f"draft vocab ({draft_model.vocab}) != target vocab "
+                    f"({model.vocab})")
+            if max_ctx + cfg.spec_k > draft_model.max_len:
+                raise ValueError(
+                    f"cache reach plus spec_k exceeds the draft model's "
+                    f"max_len ({draft_model.max_len})")
         self.scheduler = AdmissionScheduler(
             max_seqs=cfg.max_seqs, page_size=cfg.page_size,
             num_pages=cfg.num_pages,
             max_pages_per_seq=cfg.max_pages_per_seq,
             chunk_tokens=cfg.chunk_tokens, eos_id=cfg.eos_id,
-            policy=cfg.policy)
+            policy=cfg.policy, prefix_cache=cfg.prefix_cache)
 
         tp = cfg.tp_size
         if tp > 1:
@@ -153,6 +173,40 @@ class InferenceEngine:
             self._ck, self._cv = cache.k, cache.v
         self._fwd = self._build_forward()
 
+        self.draft_model = draft_model
+        self._fwd_spec = None
+        self._last_spec = None      # (step, accept decisions) of the last
+        #                             spec forward — lockstep-verified via
+        #                             the next step's plan envelope
+        self._spec_pickups = 0
+        if cfg.spec_k:
+            dn_kv = draft_model.n_kv_heads or draft_model.n_heads
+            dhead = draft_model.d_model // draft_model.n_heads
+            if tp > 1:
+                from chainermn_tpu.serving.weights import shard_params_tp
+                if dn_kv % tp:
+                    raise ValueError(
+                        f"tp_size ({tp}) must divide the draft model's "
+                        f"n_kv_heads ({dn_kv})")
+                self._draft_tp = draft_model.clone(tp_size=tp,
+                                                   tp_axis="tp")
+                self._dparams = jax.device_put(shard_params_tp(
+                    draft_params, tp, n_heads=draft_model.n_heads,
+                    n_kv_heads=dn_kv), tp_sharding)
+                dcache = _kv.init_kv_cache(
+                    draft_model.n_layers, cfg.num_pages, cfg.page_size,
+                    dn_kv // tp, dhead, cfg.cache_dtype)
+                self._dck = stack_tp(dcache.k)
+                self._dcv = stack_tp(dcache.v)
+            else:
+                self._draft_tp = draft_model
+                self._dparams = draft_params
+                dcache = _kv.init_kv_cache(
+                    draft_model.n_layers, cfg.num_pages, cfg.page_size,
+                    dn_kv, dhead, cfg.cache_dtype)
+                self._dck, self._dcv = dcache.k, dcache.v
+            self._fwd_spec = self._build_forward_spec()
+
         self._step_idx = 0
         self._arrivals: Dict[int, float] = {}
         self._token_times: Dict[int, List[float]] = {}
@@ -179,6 +233,35 @@ class InferenceEngine:
                                    "free KV pages"),
                 "step_s": reg.histogram("serving_step_seconds",
                                         "wall time per engine step"),
+                # speculative-decoding family
+                "spec_rows": reg.counter(
+                    "serving_spec_rows",
+                    "decode rows run through the draft+verify step"),
+                "spec_proposed": reg.counter(
+                    "serving_spec_proposed_tokens",
+                    "draft tokens proposed (k per decode row)"),
+                "spec_accepted": reg.counter(
+                    "serving_spec_accepted_tokens",
+                    "draft tokens accepted by the target verify pass"),
+                "spec_out": reg.counter(
+                    "serving_spec_out_tokens",
+                    "tokens landed per verify pass (accepted + 1)"),
+                # prefix-cache family (cumulative scheduler counters,
+                # mirrored as gauges each step)
+                "prefix_hits": reg.gauge(
+                    "serving_prefix_hits", "admissions with a cache hit"),
+                "prefix_hit_tokens": reg.gauge(
+                    "serving_prefix_hit_tokens",
+                    "prompt tokens served from shared pages"),
+                "prefix_prompt_tokens": reg.gauge(
+                    "serving_prefix_prompt_tokens",
+                    "prompt tokens across all admissions"),
+                "prefix_cached_pages": reg.gauge(
+                    "serving_prefix_cached_pages",
+                    "pages currently indexed by the prefix trie"),
+                "prefix_evictions": reg.gauge(
+                    "serving_prefix_evictions",
+                    "trie pages evicted under page pressure"),
             }
         self._fr = _flight.get_flight_recorder()
         # last plan-table content hash this engine saw (online-tuner
@@ -226,6 +309,34 @@ class InferenceEngine:
                                 swap_step=entry.get("swap_step"))
         return plan
 
+    # -- spec-decode accept decisions on the plan envelope --------------------
+    def _attach_spec(self, plan):
+        """Rank-0 side: piggyback the previous step's accept/reject
+        decisions on the plan broadcast.  Every rank computed the same
+        decisions locally (argmax on replicated logits), so this is the
+        lockstep PROOF channel, not the data channel — followers verify
+        and fail loudly on divergence instead of silently forking."""
+        if self._last_spec is not None:
+            plan = dict(plan, spec={"step": self._last_spec[0],
+                                    "decisions": self._last_spec[1]})
+        return plan
+
+    def _pickup_spec(self, plan):
+        """Every rank: check rank 0's broadcast accept decisions against
+        the ones this rank applied last step."""
+        if not isinstance(plan, dict) or "spec" not in plan:
+            return plan
+        entry = plan.pop("spec")
+        mine = self._last_spec
+        if (mine is None or entry["step"] != mine[0]
+                or entry["decisions"] != mine[1]):
+            raise RuntimeError(
+                f"lockstep desync: rank 0 broadcast spec-decode accept "
+                f"decisions {entry} but this rank applied "
+                f"{ {'step': None if mine is None else mine[0], 'decisions': None if mine is None else mine[1]} }")
+        self._spec_pickups += 1
+        return plan
+
     # -- forward -------------------------------------------------------------
     def _build_forward(self):
         model = self._model_tp
@@ -268,6 +379,127 @@ class InferenceEngine:
             in_specs=(P("tp"), P("tp"), P("tp"), P(), P(), P(), P()),
             out_specs=(P(), P(), P("tp"), P("tp")), check_vma=False))
 
+    def _build_forward_spec(self):
+        """Fused draft+verify step (one jitted program, fixed [B, S]).
+
+        Decode rows: the draft model greedily proposes ``k`` tokens in
+        ``k`` micro-steps (its KV rides the same page tables in its own
+        cache arrays), then ONE target pass scores all ``k+1`` positions
+        ``[t0, d1..dk]``; the longest matching prefix is accepted and
+        position ``a`` contributes the correction/bonus token, so every
+        verify pass lands ``a+1`` tokens.  Rollback is free by
+        construction: rejected positions hold stale KV strictly above
+        every live query position (causal-masked) and the next step's
+        writes start at the rolled-back ``pos0``, overwriting them
+        before anything can attend.  Prefill rows flow through both
+        models untouched (the draft must prefill too — its cache has to
+        cover the prompt before it can extend it).
+        """
+        tmodel = self._model_tp
+        dmodel = self._draft_tp
+        K = self.cfg.spec_k
+
+        def run(model, params, ck, cv, page_table, tokens, pos0, n_new):
+            nl = model.n_layers
+            new_k: list = [None] * nl
+            new_v: list = [None] * nl
+
+            def attend(layer, q, k, v):
+                lk = _kv.write_kv(ck[layer], page_table, pos0, n_new, k)
+                lv = _kv.write_kv(cv[layer], page_table, pos0, n_new, v)
+                new_k[layer], new_v[layer] = lk, lv
+                return _kv.paged_attention(q, lk, lv, page_table, pos0)
+
+            logits = model.apply(params, tokens, pos_offset=pos0,
+                                 attend=attend)
+            return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+        def forward_spec(params, dparams, ck, cv, dck, dcv, page_table,
+                         tokens, pos0, n_new, is_decode, prev):
+            b, s = tokens.shape
+            dec = is_decode.astype(bool)
+            # draft pass 1: prefill rows feed their chunk; decode rows
+            # feed [prev, t0] at positions L-1, L -> d1.  Re-feeding the
+            # second-to-last token heals the draft cache after a fully
+            # accepted round (the bonus token's predecessor was never
+            # drafted, so its draft KV is missing); in every other round
+            # the rewrite is an identical-value no-op.
+            d_tok1 = jnp.where(
+                dec[:, None],
+                jnp.zeros((b, s), jnp.int32)
+                .at[:, 0].set(prev).at[:, 1].set(tokens[:, 0]),
+                tokens)
+            d_n1 = jnp.where(dec, 2, n_new)
+            d_pos1 = jnp.where(dec, pos0 - 1, pos0)
+            dlog, dck, dcv = run(dmodel, dparams, dck, dcv, page_table,
+                                 d_tok1, d_pos1, d_n1)
+            last1 = jnp.clip(d_n1 - 1, 0, s - 1)
+            cur = jnp.argmax(jnp.take_along_axis(
+                dlog, last1[:, None, None], axis=1)[:, 0],
+                axis=-1).astype(jnp.int32)
+            drafts = [cur]
+            for i in range(1, K):   # micro-steps: d_i at position L+i
+                step_tokens = jnp.zeros((b, s), jnp.int32
+                                        ).at[:, 0].set(cur)
+                dlog, dck, dcv = run(dmodel, dparams, dck, dcv,
+                                     page_table, step_tokens, pos0 + i,
+                                     jnp.where(dec, 1, 0))
+                cur = jnp.argmax(dlog[:, 0], axis=-1).astype(jnp.int32)
+                drafts.append(cur)
+            d_mat = jnp.stack(drafts, axis=1)            # [B, K]
+            # one target pass over [t0, d1..dk] (decode) / chunk (prefill)
+            dec_tokens = jnp.concatenate(
+                [tokens[:, :1], d_mat,
+                 jnp.zeros((b, s - (K + 1)), jnp.int32)], axis=1)
+            ver_tokens = jnp.where(dec[:, None], dec_tokens, tokens)
+            t_n = jnp.where(dec, K + 1, n_new)
+            tlog, ck, cv = run(tmodel, params, ck, cv, page_table,
+                               ver_tokens, pos0, t_n)
+            g = jnp.argmax(tlog[:, :K + 1, :], axis=-1).astype(jnp.int32)
+            # greedy accept: longest leading prefix with d_i == g_{i-1}
+            match = (d_mat == g[:, :K]).astype(jnp.int32)
+            a = jnp.cumprod(match, axis=1).sum(axis=1)   # [B] accepted
+            j_idx = jnp.arange(K + 1)[None, :]
+            g_a = jnp.take_along_axis(g, a[:, None], axis=1)  # correction
+            d_pad = jnp.concatenate(
+                [d_mat, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            out_dec = jnp.where(j_idx < a[:, None], d_pad, g_a)
+            # prefill rows: greedy token at the last valid position
+            lastp = jnp.clip(n_new - 1, 0, s - 1)
+            p_logits = jnp.take_along_axis(
+                tlog, lastp[:, None, None], axis=1)[:, 0]    # [B, vocab]
+            p_tok = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
+            out_pre = jnp.concatenate(
+                [p_tok[:, None], jnp.zeros((b, K), jnp.int32)], axis=1)
+            out = jnp.where(dec[:, None], out_dec, out_pre)  # [B, K+1]
+            n_out = jnp.where(dec, a + 1, 1)
+            last_logits = jnp.where(dec[:, None], tlog[:, 0, :], p_logits)
+            return out, n_out, last_logits, ck, cv, dck, dcv
+
+        if self._mesh is None:
+            return jax.jit(forward_spec)
+
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu import utils as _utils
+
+        def body(params_st, dparams_st, ck_st, cv_st, dck_st, dcv_st,
+                 page_table, tokens, pos0, n_new, is_decode, prev):
+            params = jax.tree.map(lambda x: x[0], params_st)
+            dparams = jax.tree.map(lambda x: x[0], dparams_st)
+            out, n_out, last_logits, ck, cv, dck, dcv = forward_spec(
+                params, dparams, ck_st[0], cv_st[0], dck_st[0], dcv_st[0],
+                page_table, tokens, pos0, n_new, is_decode, prev)
+            return (out, n_out, last_logits, ck[None], cv[None],
+                    dck[None], dcv[None])
+
+        return jax.jit(_utils.shard_map(
+            body, mesh=self._mesh,
+            in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp"),
+                      P("tp"), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P("tp"), P("tp"), P("tp"), P("tp")),
+            check_vma=False))
+
     # -- client side ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
                arrival: Optional[float] = None) -> int:
@@ -286,8 +518,8 @@ class InferenceEngine:
         t0 = time.perf_counter()
         sched = self.scheduler
         if self.plane.size > 1:
-            plan = self._attach_plan_table(sched.build_plan()) \
-                if self.plane.rank == 0 else None
+            plan = self._attach_spec(self._attach_plan_table(
+                sched.build_plan())) if self.plane.rank == 0 else None
             btok = None
             if self._fr is not None:
                 btok = self._fr.span_begin("object", "serving_plan_bcast",
@@ -296,8 +528,9 @@ class InferenceEngine:
             if self._fr is not None:
                 self._fr.span_end(btok)
         else:
-            plan = self._attach_plan_table(sched.build_plan())
-        plan = self._pickup_plan_table(plan)
+            plan = self._attach_spec(self._attach_plan_table(
+                sched.build_plan()))
+        plan = self._pickup_spec(self._pickup_plan_table(plan))
         tok = None
         if self._fr is not None:
             tok = self._fr.span_begin(
@@ -311,6 +544,7 @@ class InferenceEngine:
         ran = bool(n_new.sum())
         emitted: list = []
         last_logits = None
+        spec_stats = None
         if ran:
             ftok = None
             if self._fr is not None:
@@ -323,41 +557,90 @@ class InferenceEngine:
                     "serving", "serving_forward", step=self._step_idx,
                     n_new=int(n_arr.sum()),
                     decode_slots=int((n_arr == 1).sum()),
-                    prefill_slots=int((n_arr > 1).sum()))
-            sampled_d, logits_d, self._ck, self._cv = self._fwd(
-                self._params, self._ck, self._cv,
-                jnp.asarray(batch["page_table"]),
-                jnp.asarray(batch["tokens"]), jnp.asarray(batch["pos0"]),
-                jnp.asarray(n_new))
-            sampled = np.asarray(sampled_d)   # device sync point
-            if self.cfg.keep_logits:
-                last_logits = np.asarray(logits_d)
-            if self._fr is not None:
-                self._fr.span_end(ftok)
-            emitted = sched.note_sampled(n_new, sampled)
+                    prefill_slots=int((n_arr > 1).sum()),
+                    spec=bool(self._fwd_spec is not None))
+            if self._fwd_spec is not None:
+                dec = batch["decode"]
+                out_d, n_out_d, logits_d, self._ck, self._cv, \
+                    self._dck, self._dcv = self._fwd_spec(
+                        self._params, self._dparams, self._ck, self._cv,
+                        self._dck, self._dcv,
+                        jnp.asarray(batch["page_table"]),
+                        jnp.asarray(batch["tokens"]),
+                        jnp.asarray(batch["pos0"]), jnp.asarray(n_new),
+                        jnp.asarray(dec), jnp.asarray(batch["prev"]))
+                out = np.asarray(out_d)       # device sync point
+                n_out = np.asarray(n_out_d)
+                if self.cfg.keep_logits:
+                    last_logits = np.asarray(logits_d)
+                if self._fr is not None:
+                    self._fr.span_end(ftok)
+                emitted = sched.note_sampled_spec(n_new, out, n_out)
+                decisions = [
+                    [int(i), int(n_out[i]),
+                     [int(t) for t in out[i, :n_out[i]]]]
+                    for i in range(len(n_out))
+                    if dec[i] and n_new[i] > 0]
+                self._last_spec = [self._step_idx, decisions]
+                rows = len(decisions)
+                spec_stats = {
+                    "rows": rows,
+                    "proposed": rows * self.cfg.spec_k,
+                    "accepted": sum(d[1] - 1 for d in decisions),
+                    "out_tokens": sum(d[1] for d in decisions),
+                }
+            else:
+                sampled_d, logits_d, self._ck, self._cv = self._fwd(
+                    self._params, self._ck, self._cv,
+                    jnp.asarray(batch["page_table"]),
+                    jnp.asarray(batch["tokens"]),
+                    jnp.asarray(batch["pos0"]),
+                    jnp.asarray(n_new))
+                sampled = np.asarray(sampled_d)   # device sync point
+                if self.cfg.keep_logits:
+                    last_logits = np.asarray(logits_d)
+                if self._fr is not None:
+                    self._fr.span_end(ftok)
+                emitted = sched.note_sampled(n_new, sampled)
             now = time.perf_counter()
             for rid, _tok, _n in emitted:
                 self._token_times.setdefault(rid, []).append(now)
 
         if self._m is not None:
-            decode = sum(1 for i in range(len(n_new))
-                         if n_new[i] == 1 and emitted)
-            del decode  # derived lanes live in obs_report
             self._m["steps"].inc()
             self._m["gen"].inc(len(emitted))
-            self._m["prefill"].inc(int(n_new.sum()) - len(emitted))
+            if spec_stats is None:
+                self._m["prefill"].inc(int(n_new.sum()) - len(emitted))
+            else:
+                dec_arr = batch["decode"]
+                self._m["prefill"].inc(
+                    int(n_new[dec_arr == 0].sum()))
+                self._m["spec_rows"].inc(spec_stats["rows"])
+                self._m["spec_proposed"].inc(spec_stats["proposed"])
+                self._m["spec_accepted"].inc(spec_stats["accepted"])
+                self._m["spec_out"].inc(spec_stats["out_tokens"])
             self._m["admitted"].inc(len(plan["admit"]))
             self._m["retired"].inc(len(plan["retire"]))
             self._m["active"].set(sched.active_count)
             self._m["queue"].set(sched.queue_depth)
             self._m["pages"].set(sched.allocator.num_free)
+            if sched.prefix is not None:
+                ps = sched.prefix_stats()
+                self._m["prefix_hits"].set(ps["hits"])
+                self._m["prefix_hit_tokens"].set(ps["hit_tokens"])
+                self._m["prefix_prompt_tokens"].set(ps["prompt_tokens"])
+                self._m["prefix_cached_pages"].set(ps["cached_pages"])
+                self._m["prefix_evictions"].set(ps["evictions"])
             self._m["step_s"].observe(time.perf_counter() - t0)
         if self._fr is not None:
-            self._fr.span_end(tok, emitted=len(emitted),
-                              ran_forward=ran)
+            self._fr.span_end(
+                tok, emitted=len(emitted), ran_forward=ran,
+                spec_accepted=0 if spec_stats is None
+                else spec_stats["accepted"])
         res = StepResult(step=self._step_idx, plan=plan, emitted=emitted,
                          completed=completed, ran_forward=ran,
-                         last_logits=last_logits, n_new=n_new)
+                         last_logits=last_logits, n_new=n_new,
+                         spec=spec_stats)
         self._step_idx += 1
         return res
 

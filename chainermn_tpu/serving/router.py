@@ -1,0 +1,192 @@
+"""Session-affine routing over N inference-engine replicas.
+
+The fleet layer above :class:`~chainermn_tpu.serving.engine.InferenceEngine`:
+one engine replica is one lockstep serving world (a mesh plus its
+controllers), and the router is the DCN-side dispatcher that spreads
+*requests* — never tokens — across replicas.  Two rules:
+
+* **Session affinity** — every turn of a session lands on the replica
+  that served its first turn, so the replica's prefix cache already
+  holds the session's shared prompt pages (routing a turn elsewhere
+  would re-prefill from scratch AND fragment the trie).
+* **Least load** — a session's FIRST turn goes to the replica with the
+  lowest load signal: queue depth + active slots + page pressure
+  (``1 - free/total``), tie-broken by replica index so every controller
+  that replays the same submit sequence picks the same replica.
+
+Weight distribution to the fleet rides the planner's first-class
+multicast stages: :meth:`Router.distribute_weights` wraps
+:func:`~chainermn_tpu.serving.weights.broadcast_inference_params` with a
+tuned :func:`~chainermn_tpu.planner.plans.multicast_plan` — hierarchical
+(one DCN crossing per node) whenever the communicator spans more than
+one node — instead of N repeated point-to-point sends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Hashable, List, Optional, Tuple
+
+from chainermn_tpu.serving.engine import Completion, InferenceEngine
+
+
+@dataclasses.dataclass
+class ReplicaStatus:
+    """One replica's load signals (the dispatch inputs)."""
+
+    replica: int
+    queue_depth: int
+    active: int
+    free_pages: int
+    num_pages: int
+
+    @property
+    def page_pressure(self) -> float:
+        return 1.0 - self.free_pages / max(self.num_pages, 1)
+
+    @property
+    def load(self) -> float:
+        return self.queue_depth + self.active + self.page_pressure
+
+
+class Router:
+    """Session-affine dispatch over ``engines`` (one per replica).
+
+    ``submit()`` returns a router-scoped request id; ``step()`` advances
+    every busy replica one engine step; ``run_until_idle()`` drains the
+    fleet.  Completions aggregate in :attr:`completions` as
+    ``(replica, session, Completion)`` triples.
+    """
+
+    def __init__(self, engines: List[InferenceEngine]):
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        self.engines = list(engines)
+        self._session_replica: Dict[Hashable, int] = {}
+        self._rid_map: Dict[int, Tuple[int, int]] = {}  # router -> (rep, rid)
+        self._session_of: Dict[Tuple[int, int], Hashable] = {}
+        self._next_rid = 0
+        self.dispatch_log: List[Tuple[int, Hashable, int]] = []
+        self.completions: List[Tuple[int, Hashable, Completion]] = []
+        self._claimed: Dict[int, int] = {}  # per-replica completions seen
+
+        from chainermn_tpu.observability.registry import (enabled,
+                                                          get_registry)
+        self._m = None
+        if enabled():
+            reg = get_registry()
+            self._m = {
+                "dispatched": reg.counter(
+                    "serving_router_dispatched",
+                    "requests dispatched to replicas"),
+                "sessions": reg.gauge(
+                    "serving_router_sessions", "distinct sessions seen"),
+                "load": reg.gauge(
+                    "serving_router_replica_load",
+                    "per-replica load signal at last dispatch"),
+            }
+
+    # -- load signals --------------------------------------------------------
+    def status(self) -> List[ReplicaStatus]:
+        out = []
+        for i, eng in enumerate(self.engines):
+            sched = eng.scheduler
+            out.append(ReplicaStatus(
+                replica=i, queue_depth=sched.queue_depth,
+                active=sched.active_count,
+                free_pages=sched.allocator.num_free,
+                num_pages=sched.num_pages))
+        return out
+
+    def _pick_replica(self, session: Optional[Hashable]) -> int:
+        if session is not None and session in self._session_replica:
+            return self._session_replica[session]
+        st = self.status()
+        best = min(st, key=lambda s: (s.load, s.replica))
+        if session is not None:
+            self._session_replica[session] = best.replica
+        return best.replica
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               session: Optional[Hashable] = None,
+               arrival: Optional[float] = None) -> int:
+        """Dispatch a request; same ``session`` -> same replica."""
+        arrival = time.perf_counter() if arrival is None else arrival
+        rep = self._pick_replica(session)
+        eng_rid = self.engines[rep].submit(prompt, max_new_tokens,
+                                           arrival=arrival)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._rid_map[rid] = (rep, eng_rid)
+        self._session_of[(rep, eng_rid)] = session
+        self.dispatch_log.append((rid, session, rep))
+        if self._m is not None:
+            self._m["dispatched"].inc(replica=str(rep))
+            self._m["sessions"].set(len(self._session_replica))
+            self._m["load"].set(self.status()[rep].load,
+                                replica=str(rep))
+        return rid
+
+    def replica_of(self, rid: int) -> int:
+        return self._rid_map[rid][0]
+
+    def idle(self) -> bool:
+        return all(e.idle() for e in self.engines)
+
+    # -- the fleet step loop -------------------------------------------------
+    def _collect(self, rep: int) -> None:
+        comps = self.engines[rep].completions
+        seen = self._claimed.get(rep, 0)
+        for comp in comps[seen:]:
+            self.completions.append(
+                (rep, self._session_of.get((rep, comp.rid)), comp))
+        self._claimed[rep] = len(comps)
+
+    def step(self) -> int:
+        """Step every busy replica once; returns how many stepped."""
+        stepped = 0
+        for i, eng in enumerate(self.engines):
+            if not eng.idle():
+                eng.step()
+                self._collect(i)
+                stepped += 1
+        return stepped
+
+    def run_until_idle(self, max_steps: int = 10_000) \
+            -> List[Tuple[int, Hashable, Completion]]:
+        start = len(self.completions)
+        for _ in range(max_steps):
+            if self.idle():
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"fleet still busy after {max_steps} steps: "
+                f"{[(s.replica, s.queue_depth, s.active) for s in self.status()]}")
+        return self.completions[start:]
+
+    # -- fleet weight distribution -------------------------------------------
+    @staticmethod
+    def distribute_weights(comm, params, root: int = 0, *, plan=None):
+        """Ship ``root``'s consolidated params to every replica device
+        through a planner multicast plan (ONE masked-psum collective per
+        stage — census-checkable — not repeated p2p sends).  Defaults to
+        the tuned shape for the communicator's topology: hierarchical
+        multicast (intra stage + one DCN crossing per node) when the
+        topology spans multiple nodes, flat multicast otherwise."""
+        from chainermn_tpu.serving.weights import (
+            broadcast_inference_params, weights_multicast_plan)
+
+        if plan is None:
+            topo = comm.plan_topology()
+            hier = any(n == "inter" and size > 1 for n, size in topo.axes)
+            plan = weights_multicast_plan(root=root, hierarchical=hier,
+                                          topology=topo,
+                                          name="router_weights")
+        return broadcast_inference_params(comm, params, root=root,
+                                          plan=plan)
+
+
+__all__ = ["ReplicaStatus", "Router"]

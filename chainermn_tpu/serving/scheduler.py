@@ -36,7 +36,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from chainermn_tpu.serving.kv_cache import PageAllocator
+from chainermn_tpu.serving.kv_cache import PageAllocator, PrefixCache
 
 _POLICIES = ("continuous", "static")
 
@@ -62,13 +62,16 @@ class _Slot:
     seq_len: int = 0                 # tokens whose KV sit in the cache
     generated: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
+    hit_tokens: int = 0              # prompt tokens served from the prefix cache
+    indexed: bool = False            # prompt pages already in the prefix trie
 
 
 class AdmissionScheduler:
     def __init__(self, *, max_seqs: int, page_size: int, num_pages: int,
                  max_pages_per_seq: int, chunk_tokens: int,
                  eos_id: Optional[int] = None,
-                 policy: str = "continuous"):
+                 policy: str = "continuous",
+                 prefix_cache: bool = False):
         if policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}, "
                              f"got {policy!r}")
@@ -80,6 +83,8 @@ class AdmissionScheduler:
         self.eos_id = eos_id
         self.policy = policy
         self.allocator = PageAllocator(num_pages)
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(page_size, self.allocator) if prefix_cache else None)
         self.slots: List[Optional[_Slot]] = [None] * max_seqs
         self.waiting: Deque[Request] = deque()   # rank 0 only
         # trash page = physical index num_pages (kv_cache layout);
@@ -87,6 +92,12 @@ class AdmissionScheduler:
         self.page_table = np.full((max_seqs, max_pages_per_seq),
                                   num_pages, np.int32)
         self._next_rid = 0
+        # prefix-cache stats, updated in apply_plan/note_sampled so every
+        # rank counts identically
+        self.prefix_admits = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
 
     # -- client side (rank 0) ------------------------------------------------
     def pages_needed(self, prompt_len: int, max_new: int) -> int:
@@ -128,29 +139,67 @@ class AdmissionScheduler:
 
     # -- lockstep plan: decide (rank 0), broadcast, apply (all ranks) --------
     def build_plan(self) -> dict:
-        """Pure decision: which finished slots retire this step and which
-        waiting requests are admitted into which slots.  Mutates nothing —
-        the same plan is applied by every rank via :meth:`apply_plan`."""
+        """Pure decision: which finished slots retire this step, which
+        prefix-cache pages are evicted, and which waiting requests are
+        admitted into which slots (with their cache-hit pages).  Mutates
+        nothing — the same plan is applied by every rank via
+        :meth:`apply_plan`."""
         retire = [[i, s.rid] for i, s in enumerate(self.slots)
                   if s is not None and s.finished]
         retiring = {i for i, _ in retire}
         free_slots = [i for i, s in enumerate(self.slots)
                       if s is None or i in retiring]
-        free_pages = self.allocator.num_free + sum(
-            len(self.slots[i].pages) for i in retiring)
+        retiring_pages = [p for i in retiring for p in self.slots[i].pages]
+        # Refcount-aware: a retiring slot's shared pages stay resident
+        # (the prefix trie still holds them) — only pages whose last
+        # holder is the retiring slot actually come back.
+        free_pages = (self.allocator.num_free
+                      + self.allocator.would_free(retiring_pages))
         admit = []
+        evict: List[int] = []
+        evicted_set: set = set()
+        protect: set = set()
         if self.policy == "static" and len(free_slots) < self.max_seqs:
             free_slots = []  # static batch: wait for the whole batch
         for req in self.waiting:
             if not free_slots:
                 break
+            hit_pages: List[int] = []
+            hit_tokens = 0
+            if self.prefix is not None:
+                hit_pages, hit_tokens = self.prefix.lookup(req.prompt)
+                for j, p in enumerate(hit_pages):
+                    if p in evicted_set:  # already claimed by this plan
+                        hit_pages = hit_pages[:j]
+                        hit_tokens = j * self.page_size
+                        break
             need = self.pages_needed(len(req.prompt), req.max_new_tokens)
-            if need > free_pages:
-                break  # FIFO head-of-line: keep admission order stable
-            admit.append([free_slots.pop(0), req.rid, list(req.prompt),
-                          req.max_new_tokens])
-            free_pages -= need
-        return {"retire": retire, "admit": admit}
+            need_new = need - len(hit_pages)
+            if need_new > free_pages:
+                shortfall = need_new - free_pages
+                more = []
+                if self.prefix is not None:
+                    want = len(evict) + shortfall
+                    full = self.prefix.plan_evictions(
+                        want, exclude=protect | set(hit_pages))
+                    if len(full) >= want:
+                        more = full[len(evict):]
+                if not more:
+                    break  # FIFO head-of-line: keep admission order stable
+                evict.extend(more)
+                evicted_set.update(more)
+                free_pages += len(more)
+            protect.update(hit_pages)
+            entry = [free_slots.pop(0), req.rid, list(req.prompt),
+                     req.max_new_tokens]
+            if self.prefix is not None:
+                entry += [hit_tokens, list(hit_pages)]
+            admit.append(entry)
+            free_pages -= need_new
+        plan = {"retire": retire, "admit": admit}
+        if evict:
+            plan["evict"] = evict
+        return plan
 
     def apply_plan(self, plan: dict) -> list:
         """Apply a (possibly remote) plan deterministically.  Returns the
@@ -168,21 +217,49 @@ class AdmissionScheduler:
             self.page_table[slot_idx, :] = self.num_pages
             self.slots[slot_idx] = None
             retired.append((slot_idx, slot))
-        for slot_idx, rid, prompt, max_new in plan["admit"]:
+        evict = plan.get("evict") or []
+        if evict:
+            if self.prefix is None:
+                raise RuntimeError(
+                    "lockstep desync: plan evicts prefix pages but this "
+                    "rank has no prefix cache")
+            self.prefix.evict_pages(evict)
+        for entry in plan["admit"]:
+            slot_idx, rid, prompt, max_new = entry[:4]
+            hit_tokens = int(entry[4]) if len(entry) > 4 else 0
+            hit_pages = [int(p) for p in entry[5]] if len(entry) > 5 else []
             if self.slots[slot_idx] is not None:
                 raise RuntimeError(
                     f"lockstep desync: admitting rid {rid} into occupied "
                     f"slot {slot_idx}")
+            if hit_pages:
+                got, _ = self.prefix.lookup(prompt)
+                if got[:len(hit_pages)] != hit_pages:
+                    raise RuntimeError(
+                        f"lockstep desync: plan admits rid {rid} with "
+                        f"prefix hit {hit_pages} but this rank's trie "
+                        f"holds {got[:len(hit_pages)]}")
+                self.allocator.retain(hit_pages)
+                self.prefix.touch(prompt, len(hit_pages))
             need = self.pages_needed(len(prompt), max_new)
-            pages = self.allocator.alloc(need)
-            if pages is None:
+            fresh = self.allocator.alloc(need - len(hit_pages))
+            if fresh is None:
                 raise RuntimeError(
                     f"lockstep desync: no pages for admitted rid {rid} "
-                    f"(need {need}, free {self.allocator.num_free})")
+                    f"(need {need - len(hit_pages)}, free "
+                    f"{self.allocator.num_free})")
+            pages = hit_pages + fresh
             self.slots[slot_idx] = _Slot(rid=rid, prompt=list(prompt),
-                                         max_new=max_new, pages=pages)
+                                         max_new=max_new, pages=pages,
+                                         seq_len=hit_tokens,
+                                         hit_tokens=hit_tokens)
             self.page_table[slot_idx, :] = self.num_pages
             self.page_table[slot_idx, :len(pages)] = pages
+            self.prefix_admits += 1
+            self.prefix_prompt_tokens += len(prompt)
+            if hit_tokens:
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += hit_tokens
             if self.waiting and self.waiting[0].rid == rid:
                 self.waiting.popleft()  # rank 0 drains its queue
         return retired
@@ -198,6 +275,8 @@ class AdmissionScheduler:
         tokens = np.zeros((b, s), np.int32)
         pos0 = np.zeros((b,), np.int32)
         n_new = np.zeros((b,), np.int32)
+        decode = np.zeros((b,), np.int32)
+        prev = np.zeros((b,), np.int32)
         for i, slot in enumerate(self.slots):
             if slot is None or slot.finished:
                 continue
@@ -209,8 +288,26 @@ class AdmissionScheduler:
             else:                                        # decode: 1 token
                 tokens[i, 0] = slot.generated[-1]
                 n_new[i] = 1
+                decode[i] = 1  # a 1-token prefill tail is NOT decode —
+                #                only the host can tell (spec-decode mask)
+                # second-to-last sequence token (position seq_len - 1):
+                # the spec draft re-feeds it to heal the one-position
+                # draft-cache hole a fully-accepted round leaves behind
+                prev[i] = (slot.generated[-2] if len(slot.generated) > 1
+                           else slot.prompt[-1])
         return {"tokens": tokens, "pos0": pos0, "n_new": n_new,
+                "decode": decode, "prev": prev,
                 "page_table": self.page_table.copy()}
+
+    def _maybe_index_prefix(self, slot: _Slot) -> None:
+        """Index a just-prefilled slot's full prompt pages in the trie
+        (every rank runs this at the same step — lockstep-identical)."""
+        if self.prefix is None or slot.indexed:
+            return
+        slot.indexed = True
+        n_full = len(slot.prompt) // self.page_size
+        if n_full:
+            self.prefix.insert(slot.prompt, slot.pages, n_full)
 
     def note_sampled(self, n_new: np.ndarray, sampled: np.ndarray) -> list:
         """Advance slot state after the forward.  ``sampled[i]`` is the
@@ -218,20 +315,62 @@ class AdmissionScheduler:
         emitted tokens ``[(rid, token, n_generated)]`` — a sequence emits
         only once its whole prompt is in the cache (the step that
         consumed the final prompt chunk produces its first token)."""
+        sampled = np.asarray(sampled)
+        return self.note_sampled_spec(
+            n_new, sampled.reshape(len(sampled), 1),
+            np.ones(len(sampled), np.int32))
+
+    def note_sampled_spec(self, n_new: np.ndarray, out_tokens: np.ndarray,
+                          n_out: np.ndarray) -> list:
+        """Spec-decode generalization of :meth:`note_sampled`: a decoding
+        slot may land up to ``n_out[i]`` tokens this step
+        (``out_tokens[i, :n_out[i]]`` = accepted draft tokens plus the
+        target's correction/bonus token), truncated at ``max_new``/EOS.
+        ``seq_len`` advances by the kept count — the KV of every kept
+        token except the last is already in the cache, preserving the
+        vanilla decode invariant."""
         emitted = []
         for i, slot in enumerate(self.slots):
             if slot is None or slot.finished or n_new[i] == 0:
                 continue
-            slot.seq_len += int(n_new[i])
-            if slot.seq_len < len(slot.prompt):
-                continue  # still prefilling
-            tok = int(sampled[i])
-            slot.generated.append(tok)
-            emitted.append((slot.rid, tok, len(slot.generated)))
-            if (len(slot.generated) >= slot.max_new
-                    or (self.eos_id is not None and tok == self.eos_id)):
-                slot.finished = True
+            if slot.seq_len < len(slot.prompt):          # prefill row
+                slot.seq_len += int(n_new[i])
+                if slot.seq_len < len(slot.prompt):
+                    continue  # still prefilling
+                self._maybe_index_prefix(slot)
+                tok = int(out_tokens[i, 0])
+                slot.generated.append(tok)
+                emitted.append((slot.rid, tok, len(slot.generated)))
+                if (len(slot.generated) >= slot.max_new
+                        or (self.eos_id is not None
+                            and tok == self.eos_id)):
+                    slot.finished = True
+                continue
+            kept = 0                                     # decode row
+            for j in range(int(n_out[i])):
+                tok = int(out_tokens[i, j])
+                slot.generated.append(tok)
+                kept += 1
+                emitted.append((slot.rid, tok, len(slot.generated)))
+                if (len(slot.generated) >= slot.max_new
+                        or (self.eos_id is not None
+                            and tok == self.eos_id)):
+                    slot.finished = True
+                    break
+            slot.seq_len += kept
         return emitted
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache counters (identical on every rank)."""
+        return {
+            "enabled": self.prefix is not None,
+            "admits": self.prefix_admits,
+            "hits": self.prefix_hits,
+            "hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens": self.prefix_prompt_tokens,
+            "cached_pages": 0 if self.prefix is None else len(self.prefix),
+            "evictions": 0 if self.prefix is None else self.prefix.evictions,
+        }
 
 
 __all__ = ["AdmissionScheduler", "Request"]

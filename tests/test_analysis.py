@@ -298,6 +298,98 @@ ENTRY e {
     assert f.details["observed_dtype"] == "f32"
 
 
+def test_rule_census_drift_accepts_hlo_text_and_callable(devices):
+    """The census seam takes the program to audit three ways: ``True``
+    (the communicator's own allreduce), raw HLO text, or a lazy callable
+    — text and callable must feed the same drift check, and a callable
+    that blows up must degrade to a skip, never a crash."""
+    from chainermn_tpu.analysis.lint import allreduce_hlo
+
+    comm = chainermn_tpu.create_communicator("xla")
+    hlo = allreduce_hlo(comm)
+    # raw HLO text, right flavor -> clean; wrong flavor -> fires
+    rep = lint_step(None, comm=comm, flavor="xla", census=hlo,
+                    rules=["census-drift"], raise_on_error=False)
+    assert not rep.findings, rep.findings
+    rep = lint_step(None, comm=comm, flavor="hierarchical", inter_size=2,
+                    census=hlo, rules=["census-drift"],
+                    raise_on_error=False)
+    f = _only(rep, "census-drift")
+    assert f.details["observed"] == ["all-reduce"]
+    # callable: invoked lazily, same verdicts
+    rep = lint_step(None, comm=comm, flavor="hierarchical", inter_size=2,
+                    census=lambda: hlo, rules=["census-drift"],
+                    raise_on_error=False)
+    _only(rep, "census-drift")
+
+    def boom():
+        raise RuntimeError("probe died")
+    rep = lint_step(None, comm=comm, flavor="xla", census=boom,
+                    rules=["census-drift"], raise_on_error=False)
+    assert not rep.findings
+    assert "census-drift" in rep.skipped
+    assert "probe died" in str(rep.skipped["census-drift"])
+
+
+def test_rule_census_drift_fires_through_spec_decode_path(devices):
+    """Census-drift through the speculative-decoding fused step: the
+    tp=2 draft+verify program's own compiled HLO (many Megatron psums —
+    draft micro-steps plus the verify pass) rides the ``census=`` text
+    seam and is held against a single-allreduce spec, so the rule must
+    fire with the spec step's real collective count observed.  Pins that
+    the serving entry point's extension did not bypass the drift check.
+    """
+    from chainermn_tpu.analysis.entrypoints import _serving_spec_target
+
+    fn, args = _serving_spec_target()
+    hlo = fn.lower(*args).compile().as_text()
+    comm = chainermn_tpu.create_communicator("xla")
+    rep = lint_step(None, comm=comm, flavor="xla", census=hlo,
+                    rules=["census-drift"], raise_on_error=False)
+    f = _only(rep, "census-drift")
+    # the fused spec step runs MANY tp psums, never the flavor's one
+    assert f.details["expected"] == ["all-reduce"]
+    assert len(f.details["observed"]) > 1
+    assert set(f.details["observed"]) == {"all-reduce"}
+
+
+def test_rule_census_drift_serving_weights_multicast(devices):
+    """Census-drift through the serving fleet's weight-distribution
+    path: the real multicast program (the router's one masked-psum stage
+    chain) holds to the plan IR's census, and a broken fixture — a
+    replica fan that all-gathers instead — fires with the plan named.
+    The broken program rides the ``census=`` callable seam, proving the
+    serving entry point's own compiled HLO (not the training allreduce)
+    is what the rule audits."""
+    from chainermn_tpu.analysis.entrypoints import lint_serving_weights
+    from chainermn_tpu.serving import weights_multicast_plan
+
+    reports = lint_serving_weights()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert not rep.findings, rep.findings
+    assert "census-drift" not in rep.skipped, rep.skipped
+
+    comm = chainermn_tpu.create_communicator("flat")
+    topo = comm.plan_topology()
+    plan = weights_multicast_plan(root=0, topology=topo,
+                                  name="serving_weights")
+
+    def broken_hlo():
+        # a drifted "broadcast": every rank all-gathers the stack — the
+        # wrong collective class for the plan's masked-psum multicast
+        return comm.compiled_hlo(
+            lambda leaf: jax.lax.all_gather(leaf, comm.data_axes,
+                                            tiled=True),
+            jnp.zeros((comm.size, 64), jnp.float32))
+
+    rep = lint_step(None, comm=comm, plan=plan, census=broken_hlo,
+                    rules=["census-drift"], raise_on_error=False)
+    f = _only(rep, "census-drift")
+    assert "plan 'serving_weights'" in f.message
+    assert "all-gather" in f.details["observed"]
+
+
 def test_rule_wire_dtype_mismatch_per_hop_compressed_plan(devices):
     """A plan stage carrying a per-hop compression spec expects the
     COMPRESSOR's wire among the compiled collective dtypes: the real

@@ -1,0 +1,412 @@
+"""Prefix-cache tests: the refcounted page allocator, the prefix trie,
+copy-on-write shared admission under page pressure, and the pinned
+bit-identical-logits comparison.
+
+The load-bearing assertion is the last one: a cache-hit admission (pages
+shared copy-on-write, prefill skipped past the hit) must produce logits
+BITWISE identical to the uncached engine — same pages, same positions,
+same program, so sharing is undetectable downstream.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving import (AdmissionScheduler, InferenceEngine,
+                                   PageAllocator, PrefixCache,
+                                   ServingConfig)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = TransformerLM(vocab=61, d_model=32, n_layers=2, n_heads=4,
+                          max_len=128, attention_impl="xla", n_kv_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _prompts(sizes, vocab=61, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, vocab, size=n))) for n in sizes]
+
+
+# ---- refcounted allocator ---------------------------------------------------
+
+class TestRefcountAllocator:
+    def test_retain_defers_free(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.retain([pages[0]])
+        assert a.refcount(pages[0]) == 2
+        a.free(pages)                       # one holder down
+        assert a.refcount(pages[0]) == 1
+        assert a.num_free == 3              # only pages[1] came back
+        a.free([pages[0]])                  # last holder
+        assert a.num_free == 4
+        assert a.refcount(pages[0]) == 0
+
+    def test_shared_page_returns_lowest_first(self):
+        a = PageAllocator(4)
+        p = a.alloc(3)                      # [0, 1, 2]
+        a.retain([p[0]])
+        a.free(p)                           # 1, 2 free; 0 still held
+        assert a.alloc(2) == [1, 2]
+
+    def test_retain_free_page_raises(self):
+        a = PageAllocator(4)
+        with pytest.raises(ValueError, match="retaining free page"):
+            a.retain([0])
+
+    def test_retain_out_of_range_raises(self):
+        a = PageAllocator(2)
+        a.alloc(2)
+        with pytest.raises(ValueError, match="out-of-range"):
+            a.retain([2])
+
+    def test_over_release_still_double_free(self):
+        a = PageAllocator(2)
+        p = a.alloc(1)
+        a.retain(p)
+        a.free(p)
+        a.free(p)
+        with pytest.raises(ValueError, match="double free of page 0"):
+            a.free(p)
+
+    def test_would_free_is_pure_lookahead(self):
+        a = PageAllocator(6)
+        p = a.alloc(4)                      # refs: all 1
+        a.retain([p[0], p[1]])              # 0,1 at ref 2
+        assert a.would_free(p) == 2         # only 2, 3 would come back
+        # duplicates in one call count as repeated decrements
+        assert a.would_free([p[0], p[0]]) == 1
+        assert a.would_free([p[0]]) == 0
+        # nothing mutated
+        assert a.num_free == 2
+        assert [a.refcount(q) for q in p] == [2, 2, 1, 1]
+
+
+# ---- prefix trie ------------------------------------------------------------
+
+def _seeded_trie(num_pages=16, page_size=4):
+    a = PageAllocator(num_pages)
+    c = PrefixCache(page_size, a)
+    return a, c
+
+
+class TestPrefixTrie:
+    def test_insert_then_lookup(self):
+        a, c = _seeded_trie()
+        prompt = list(range(10, 22))        # 3 full pages
+        pages = a.alloc(3)
+        assert c.insert(prompt, pages, 3) == 3
+        assert len(c) == 3
+        assert all(a.refcount(p) == 2 for p in pages)
+        got, hit = c.lookup(prompt + [1, 2])
+        assert got == pages and hit == 12
+
+    def test_lookup_always_leaves_one_token_to_prefill(self):
+        a, c = _seeded_trie()
+        prompt = list(range(8))             # exactly 2 pages
+        pages = a.alloc(2)
+        c.insert(prompt, pages, 2)
+        got, hit = c.lookup(prompt)         # fully cached prompt:
+        assert got == pages[:1] and hit == 4  # capped at (8-1)//4 = 1
+
+    def test_reinsert_adopts_nothing(self):
+        a, c = _seeded_trie()
+        prompt = list(range(8))
+        pages = a.alloc(2)
+        assert c.insert(prompt, pages, 2) == 2
+        other = a.alloc(2)
+        # same chunks, different pages: existing nodes win (KV identical
+        # by determinism), no new references
+        assert c.insert(prompt, other, 2) == 0
+        assert all(a.refcount(p) == 2 for p in pages)
+        assert all(a.refcount(p) == 1 for p in other)
+
+    def test_shared_prefix_branches(self):
+        a, c = _seeded_trie()
+        base = list(range(4))
+        pa, pb = a.alloc(2), a.alloc(2)
+        c.insert(base + [50, 51, 52, 53], pa, 2)
+        # second sequence shares the base chunk -> its first page is NOT
+        # adopted, only its divergent second page is
+        assert c.insert(base + [60, 61, 62, 63], pb, 2) == 1
+        assert len(c) == 3
+        got, _ = c.lookup(base + [60, 61, 62, 63, 9])
+        assert got == [pa[0], pb[1]]
+
+    def test_touch_missing_path_raises(self):
+        a, c = _seeded_trie()
+        with pytest.raises(ValueError, match="missing path"):
+            c.touch(list(range(4)), 1)
+
+    def test_plan_evictions_leaf_first_lru(self):
+        a, c = _seeded_trie()
+        p1 = a.alloc(2)
+        c.insert([1, 2, 3, 4, 5, 6, 7, 8], p1, 2)     # older chain
+        p2 = a.alloc(1)
+        c.insert([9, 9, 9, 9], p2, 1)                 # newer root
+        a.free(p1 + p2)                     # "slots" retire: trie-only refs
+        # leaf-first: the old chain's LEAF goes before its parent, and
+        # LRU order puts the old chain before the fresh one
+        assert c.plan_evictions(3) == [p1[1], p1[0], p2[0]]
+
+    def test_plan_evictions_respects_refcounts(self):
+        a, c = _seeded_trie()
+        pages = a.alloc(2)
+        c.insert(list(range(8)), pages, 2)
+        a.free(pages)                       # retire the prefilling slot
+        a.retain([pages[1]])                # a live sequence maps the leaf
+        # the leaf is pinned, and an un-evictable leaf blocks its parent
+        assert c.plan_evictions(2) == []
+        a.free([pages[1]])
+        assert c.plan_evictions(2) == [pages[1], pages[0]]
+
+    def test_plan_evictions_exclude_protects_hits(self):
+        a, c = _seeded_trie()
+        pages = a.alloc(2)
+        c.insert(list(range(8)), pages, 2)
+        a.free(pages)
+        assert c.plan_evictions(2, exclude=[pages[1]]) == []
+
+    def test_evict_pages_frees_and_unlinks(self):
+        a, c = _seeded_trie()
+        pages = a.alloc(2)
+        c.insert(list(range(8)), pages, 2)
+        a.free(pages)
+        free0 = a.num_free
+        c.evict_pages([pages[1], pages[0]])
+        assert len(c) == 0 and c.evictions == 2
+        assert a.num_free == free0 + 2
+        assert c.lookup(list(range(8)) + [1])[0] == []
+
+    def test_evict_non_leaf_raises(self):
+        a, c = _seeded_trie()
+        pages = a.alloc(2)
+        c.insert(list(range(8)), pages, 2)
+        with pytest.raises(ValueError, match="non-leaf"):
+            c.evict_pages([pages[0]])
+
+    def test_evict_uncached_raises(self):
+        a, c = _seeded_trie()
+        a.alloc(1)
+        with pytest.raises(ValueError, match="uncached"):
+            c.evict_pages([0])
+
+
+# ---- scheduler: copy-on-write shared admission ------------------------------
+
+def _sched(**kw):
+    args = dict(max_seqs=3, page_size=4, num_pages=12,
+                max_pages_per_seq=8, chunk_tokens=6, prefix_cache=True)
+    args.update(kw)
+    return AdmissionScheduler(**args)
+
+
+def _drive(sched, rng, max_steps=200):
+    """Step the scheduler (no model) until idle: fake greedy samples."""
+    for _ in range(max_steps):
+        if sched.idle():
+            return
+        sched.apply_plan(sched.build_plan())
+        batch = sched.step_batch()
+        if batch["n_new"].sum():
+            sched.note_sampled(batch["n_new"],
+                               rng.integers(1, 61, size=sched.max_seqs))
+    raise AssertionError("scheduler did not drain")
+
+
+class TestSharedAdmission:
+    def test_cache_hit_admit_reserves_only_fresh_pages(self):
+        rng = np.random.default_rng(0)
+        sched = _sched()
+        prompt = _prompts((16,))[0]
+        sched.submit(prompt, 4)             # 5 pages, trie keeps 4
+        _drive(sched, rng)
+        assert [sched.allocator.refcount(p) for p in range(4)] == [1] * 4
+        free0 = sched.allocator.num_free    # 8: pages 0-3 live in the trie
+        assert free0 == 8
+        sched.submit(prompt, 4)             # hit: 3 pages (one prefill
+        sched.apply_plan(sched.build_plan())  # page always remains)
+        slot = next(s for s in sched.slots if s is not None)
+        assert slot.hit_tokens == 12 and slot.seq_len == 12
+        assert slot.pages[:3] == [0, 1, 2]
+        # the hit pages were RETAINED, not re-allocated: exactly the two
+        # fresh pages came off the free list
+        assert sched.allocator.num_free == free0 - 2
+        assert [sched.allocator.refcount(p) for p in [0, 1, 2]] == [2] * 3
+
+    def test_pressure_evicts_lru_but_never_shared_pages(self):
+        rng = np.random.default_rng(1)
+        sched = _sched()
+        p1 = _prompts((16,))[0]
+        sched.submit(p1, 4)
+        _drive(sched, rng)                  # trie: pages [0,1,2,3]
+        sched.submit(p1, 4)                 # hit [0,1,2] -> refcount 2
+        sched.apply_plan(sched.build_plan())
+        p3 = _prompts((24,), seed=7)[0]     # 7 pages, free = 6
+        sched.submit(p3, 4)
+        plan = sched.build_plan()
+        # shortfall of 1: the only refcount-1 trie page (the chain leaf,
+        # page 3) is evicted; the shared pages survive
+        assert plan.get("evict") == [3]
+        assert len(plan["admit"]) == 1
+        sched.apply_plan(plan)
+        assert sched.prefix.evictions == 1
+        assert sched.allocator.num_free == 0
+        # nothing evictable remains (every trie page is shared with a
+        # live slot), so the next request waits instead of evicting
+        sched.submit(p1, 4)
+        plan = sched.build_plan()
+        assert plan["admit"] == [] and "evict" not in plan
+        assert sched.queue_depth == 1
+
+    def test_retire_keeps_trie_pages_resident(self):
+        rng = np.random.default_rng(2)
+        sched = _sched()
+        prompt = _prompts((16,))[0]
+        sched.submit(prompt, 4)
+        _drive(sched, rng)
+        # slot retired, but the trie still holds the full prompt pages
+        assert sched.active_count == 0
+        assert len(sched.prefix) == 4
+        assert sched.allocator.num_free == sched.num_pages - 4
+        got, hit = sched.prefix.lookup(prompt + [1])
+        assert hit == 16
+
+    def test_property_random_admit_finish_evict(self):
+        """Property test: random interleavings of submit / step / retire
+        under page pressure keep the exact refcount accounting — every
+        page's refcount equals its holder count (slots mapping it plus
+        the trie), page tables mirror slot pages, and a full drain plus
+        trie teardown returns every page."""
+        rng = np.random.default_rng(42)
+        num_pages = 20
+        sched = _sched(num_pages=num_pages, max_seqs=3,
+                       max_pages_per_seq=8, chunk_tokens=6)
+        bases = [_prompts((n,), seed=s)[0]
+                 for n, s in ((8, 10), (12, 11), (16, 12))]
+        submitted = 0
+        for it in range(240):
+            if rng.random() < 0.5 and sched.queue_depth < 6:
+                base = bases[rng.integers(len(bases))]
+                tail = list(map(int, rng.integers(1, 61,
+                                                  size=rng.integers(0, 7))))
+                sched.submit(base + tail, int(rng.integers(1, 7)))
+                submitted += 1
+            sched.apply_plan(sched.build_plan())
+            batch = sched.step_batch()
+            if batch["n_new"].sum():
+                sched.note_sampled(batch["n_new"],
+                                   rng.integers(1, 61, size=sched.max_seqs))
+            # -- invariants ------------------------------------------------
+            trie_pages = set(sched.prefix._by_page)
+            for p in range(num_pages):
+                holders = sum(s.pages.count(p) for s in sched.slots
+                              if s is not None)
+                holders += 1 if p in trie_pages else 0
+                assert sched.allocator.refcount(p) == holders, \
+                    f"iter {it}: page {p} refcount != holders {holders}"
+            n_held = sum(1 for p in range(num_pages)
+                         if sched.allocator.refcount(p) > 0)
+            assert sched.allocator.num_free + n_held == num_pages
+            for i, s in enumerate(sched.slots):
+                if s is None:
+                    continue
+                row = sched.page_table[i]
+                assert list(row[:len(s.pages)]) == s.pages
+                assert (row[len(s.pages):] == num_pages).all()
+        assert submitted > 50
+        assert sched.prefix_hits > 0        # sharing actually happened
+        _drive(sched, rng)                  # drain the tail
+        # teardown: evicting the whole trie returns every page
+        while len(sched.prefix):
+            planned = sched.prefix.plan_evictions(len(sched.prefix))
+            assert planned, "drained trie has unevictable pages"
+            sched.prefix.evict_pages(planned)
+        assert sched.allocator.num_free == num_pages
+
+
+# ---- engine: bit-identical logits + end-to-end eviction ---------------------
+
+def _run_collect(eng, prompt, max_new):
+    """Submit, drain, and return (tokens, per-emitted-token logits rows)."""
+    rid = eng.submit(prompt, max_new)
+    rows = []
+    while not eng.idle():
+        res = eng.step()
+        mine = [e for e in res.emitted if e[0] == rid]
+        if mine:
+            slot_idx = next(i for i, s in enumerate(eng.scheduler.slots)
+                            if s is not None and s.rid == rid)
+            rows.append(np.asarray(res.last_logits[slot_idx]))
+    comp = next(c for c in eng.completions if c.rid == rid)
+    return comp.tokens, rows
+
+
+class TestPrefixBitIdentical:
+    def test_cached_logits_bitwise_equal_uncached(self, tiny):
+        """THE prefix-caching pin: an admission served from shared pages
+        (prefill skipped past the hit) yields bitwise-identical logits
+        to the engine that prefilled everything from scratch."""
+        model, params = tiny
+        sys_prompt = _prompts((13,), seed=3)[0]
+        tails = _prompts((4, 6), seed=4)
+        base = dict(page_size=4, num_pages=32, max_seqs=2,
+                    chunk_tokens=8, max_pages_per_seq=8,
+                    keep_logits=True)
+        plain = InferenceEngine(model, params,
+                                ServingConfig(**base, prefix_cache=False))
+        cached = InferenceEngine(model, params,
+                                 ServingConfig(**base, prefix_cache=True))
+        for tail in tails:
+            prompt = sys_prompt + tail
+            tok_p, rows_p = _run_collect(plain, prompt, 6)
+            tok_c, rows_c = _run_collect(cached, prompt, 6)
+            assert tok_c == tok_p
+            assert len(rows_c) == len(rows_p)
+            for rp, rc in zip(rows_p, rows_c):
+                np.testing.assert_array_equal(rc, rp)
+        stats = cached.scheduler.prefix_stats()
+        # the second request really did share the 3 full sys-prompt pages
+        assert stats["hits"] == 1 and stats["hit_tokens"] == 12
+        assert plain.scheduler.prefix_stats()["hits"] == 0
+
+    def test_end_to_end_eviction_under_pressure(self, tiny):
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=10, max_seqs=1,
+                            chunk_tokens=8, max_pages_per_seq=8,
+                            prefix_cache=True)
+        eng = InferenceEngine(model, params, cfg)
+        for i, prompt in enumerate(_prompts((12, 12, 12, 12), seed=9)):
+            eng.submit(prompt, 4)
+            comps = eng.run_until_idle()
+            assert len(comps[-1].tokens) == 4
+        stats = eng.scheduler.prefix_stats()
+        assert stats["admits"] == 4
+        assert stats["evictions"] >= 3      # the 4th admission had to evict
+        # conservation after full drain: trie pages are the only holders
+        sched = eng.scheduler
+        held = sum(1 for p in range(cfg.num_pages)
+                   if sched.allocator.refcount(p) > 0)
+        assert sched.allocator.num_free + held == cfg.num_pages
+        assert held == len(sched.prefix)
+
+    def test_hit_rate_accumulates_across_sessions(self, tiny):
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=2,
+                            chunk_tokens=8, max_pages_per_seq=8,
+                            prefix_cache=True)
+        eng = InferenceEngine(model, params, cfg)
+        sys_prompt = _prompts((13,), seed=5)[0]
+        for tail in _prompts((3, 5, 4), seed=6):
+            eng.submit(sys_prompt + tail, 3)
+            eng.run_until_idle()
+        stats = eng.scheduler.prefix_stats()
+        assert stats["admits"] == 3 and stats["hits"] == 2
+        assert stats["hit_tokens"] == 24

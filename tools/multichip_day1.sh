@@ -115,6 +115,23 @@ run 0 "$OUT/SERVING_$ROUND.json" \
         $PY_TPU benchmarks/bench_serving.py --out '$OUT/SERVING_$ROUND.json' \
         --metrics '$OUT/SERVING_METRICS_$ROUND.jsonl' > /dev/null"
 
+# ---- fleet serving: prefix cache + spec decode + router ---------------
+# Hardware-free (forced CPU mesh): the full fleet artifact — prefix-
+# cache A/B, draft+verify speculative decoding, and the 2-replica
+# session-affine router open loop — then the STRICT serving floors
+# (prefix.speedup >= 1.3, spec.accept_tokens_per_step > 1.0, session
+# affinity unbroken; tools/perf_budgets.json, no regression slack).
+# Render the hit-rate/acceptance lanes with
+# `obs_report --serving $OUT/SERVING_FLEET_METRICS_$ROUND.jsonl`.
+run 0 "$OUT/SERVING_FLEET_$ROUND.json" \
+    "fleet serving gate: prefix-cache A/B + spec decode + 2-replica session-affine router, then perf_gate --serving strict floors" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_serving.py --spec-k 2 --replicas 2 \
+            --out '$OUT/SERVING_FLEET_$ROUND.json' \
+            --metrics '$OUT/SERVING_FLEET_METRICS_$ROUND.jsonl' > /dev/null \
+        && $PY_TPU tools/perf_gate.py --serving '$OUT/SERVING_FLEET_$ROUND.json' \
+            --out '$OUT/SERVING_FLEET_GATE_$ROUND.json'"
+
 # ---- normalization boundary: fused-kernel probe + remat autotune ------
 # Hardware-free (forced CPU mesh, smoke shapes) so the fused BN(+ReLU)
 # Pallas path and the remat-policy autotuner run on every host; the probe

@@ -258,9 +258,13 @@ def compression_section(records: List[dict]) -> str:
 
 def serving_section(records: List[dict]) -> str:
     """Serving lane: one row per ``bench_serving`` run (continuous vs
-    static throughput/latency from ``benchmarks/bench_serving.py``) plus
-    the latest ``serving_*`` engine gauges (queue depth, active slots,
-    free KV pages — the admission-control health signals)."""
+    static throughput/latency from ``benchmarks/bench_serving.py``), the
+    prefix-cache hit-rate lane (``bench_serving_prefix`` records +
+    ``serving_prefix_*`` counters), the speculative-decoding acceptance
+    lane (``bench_serving_spec`` records + ``serving_spec_*`` counters),
+    the fleet lane, and the latest ``serving_*`` engine gauges (queue
+    depth, active slots, free KV pages — the admission-control health
+    signals)."""
     reps = [r for r in records if r.get("kind") == "bench_serving"]
     parts = []
     if reps:
@@ -284,6 +288,85 @@ def serving_section(records: List[dict]) -> str:
     gauges = {str(name): r.get("value")
               for (name, _labels), r in latest.items()
               if str(name).startswith("serving_")}
+
+    # prefix-cache hit-rate lane: the bench A/B rows, then the live
+    # engine counters reduced to the two health ratios (hit rate by
+    # admission and by token — diverging ratios mean hits land only on
+    # short prompts)
+    prows = []
+    for r in (x for x in records if x.get("kind") == "bench_serving_prefix"):
+        stats = (r.get("cached") or {}).get("stats") or {}
+        hit_rate = (stats.get("hit_tokens", 0)
+                    / max(stats.get("prompt_tokens", 0), 1))
+        prows.append([
+            "bench",
+            f"{r['speedup']:.2f}x" if r.get("speedup") is not None else "-",
+            str(stats.get("hits", "-")), str(stats.get("admits", "-")),
+            f"{hit_rate * 100:.1f}%",
+            str(stats.get("cached_pages", "-")),
+            str(stats.get("evictions", "-")),
+        ])
+    if "serving_prefix_prompt_tokens" in gauges:
+        hits = gauges.get("serving_prefix_hits", 0.0) or 0.0
+        prows.append([
+            "engine", "-",
+            f"{int(hits)}",
+            "-",
+            f"{(gauges.get('serving_prefix_hit_tokens', 0.0) or 0.0) / max(gauges['serving_prefix_prompt_tokens'], 1.0) * 100:.1f}%",
+            f"{int(gauges.get('serving_prefix_cached_pages', 0) or 0)}",
+            f"{int(gauges.get('serving_prefix_evictions', 0) or 0)}",
+        ])
+    if prows:
+        parts.append("prefix-cache lane\n" + _table(
+            ["source", "speedup", "hits", "admits", "hit tokens",
+             "cached pages", "evictions"], prows))
+
+    # spec-decoding acceptance lane: accepted/proposed is draft quality,
+    # out_tokens/rows is the budgeted tokens-per-verify-pass (<= 1.0
+    # means speculation degenerated to plain decode)
+    srows = []
+    for r in (x for x in records if x.get("kind") == "bench_serving_spec"):
+        sp = r.get("spec") or {}
+        srows.append([
+            "bench", str(r.get("k", "-")),
+            str(sp.get("verify_rows", "-")),
+            f"{r['acceptance_rate'] * 100:.1f}%"
+            if r.get("acceptance_rate") is not None else "-",
+            f"{r['accept_tokens_per_step']:.2f}"
+            if r.get("accept_tokens_per_step") is not None else "-",
+            f"{r['speedup']:.2f}x" if r.get("speedup") is not None else "-",
+        ])
+    if gauges.get("serving_spec_rows"):
+        rows_n = gauges["serving_spec_rows"]
+        proposed = gauges.get("serving_spec_proposed_tokens", 0.0) or 0.0
+        srows.append([
+            "engine", "-", f"{int(rows_n)}",
+            f"{(gauges.get('serving_spec_accepted_tokens', 0.0) or 0.0) / max(proposed, 1.0) * 100:.1f}%",
+            f"{(gauges.get('serving_spec_out_tokens', 0.0) or 0.0) / rows_n:.2f}",
+            "-",
+        ])
+    if srows:
+        parts.append("speculative-decoding lane\n" + _table(
+            ["source", "k", "verify rows", "acceptance", "tokens/pass",
+             "speedup"], srows))
+
+    frows = []
+    for r in (x for x in records if x.get("kind") == "bench_serving_fleet"):
+        ttft = r.get("ttft_s") or {}
+        frows.append([
+            str(r.get("replicas", "-")), str(r.get("sessions", "-")),
+            str(r.get("requests", "-")),
+            f"{r['tokens_per_sec']:.1f}"
+            if r.get("tokens_per_sec") is not None else "-",
+            _fmt_s(ttft.get("p50")), _fmt_s(ttft.get("p99")),
+            "ok" if r.get("session_affinity_ok") else "VIOLATED",
+            str(r.get("prefix_hits", "-")),
+        ])
+    if frows:
+        parts.append("fleet lane\n" + _table(
+            ["replicas", "sessions", "reqs", "tok/s", "ttft p50",
+             "ttft p99", "affinity", "prefix hits"], frows))
+
     if gauges:
         rows = [[k, f"{v:.6g}" if v is not None else "-"]
                 for k, v in sorted(gauges.items())]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """perf_gate — fail loudly when a tracked benchmark regresses.
 
-Three modes, all exit nonzero on a gate failure so the runbook/CI leg
+Four modes, all exit nonzero on a gate failure so the runbook/CI leg
 that invokes them goes red instead of silently recording a slower repo:
 
 1. Budget check (default)::
@@ -43,8 +43,22 @@ that invokes them goes red instead of silently recording a slower repo:
    swap with ``retune.best_speedup`` at or above ``--retune-threshold``
    (default 1.05) and pinned a ``table_hash``.
 
-Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER and
-ONLINE_TUNE legs; see docs/collective_planner.md.
+4. Serving gate::
+
+       python tools/perf_gate.py --serving SERVING.json
+
+   Consumes a ``bench_serving.py`` artifact (schema
+   ``bench_serving/v2``) and holds it to the STRICT serving floors from
+   the budgets file (no regression slack): ``prefix.speedup`` at or
+   above the ``serving_prefix_cache_speedup`` budget (prefix caching
+   must pay), ``spec.accept_tokens_per_step`` strictly above the
+   ``serving_spec_accept_tokens_per_step`` budget (speculation must
+   beat one-token-per-step decode), and — when a fleet section is
+   present — session affinity unbroken.
+
+Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER,
+ONLINE_TUNE and SERVING_FLEET legs; see docs/collective_planner.md and
+docs/serving.md.
 """
 
 import argparse
@@ -58,6 +72,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BUDGETS_SCHEMA = "perf_budgets/v1"
 PLANNER_GATE_SCHEMA = "planner_gate/v1"
 ONLINE_TUNE_SCHEMA = "online_tune/v1"
+SERVING_SCHEMA = "bench_serving/v2"
 
 
 def _dig(doc, dotted):
@@ -282,6 +297,78 @@ def online_tune_gate(args):
     return 0 if ok else 1
 
 
+def serving_gate(args):
+    """Gate a ``bench_serving`` artifact against the serving floors in
+    the budgets file.  Unlike budget mode, the floors are STRICT — no
+    ``max_regression_pct`` slack: ``prefix.speedup`` at or above the
+    ``serving_prefix_cache_speedup`` budget and
+    ``spec.accept_tokens_per_step`` strictly above the
+    ``serving_spec_accept_tokens_per_step`` budget.  The sections must
+    be present (run the bench with ``--prefix-share`` and ``--spec-k``);
+    a fleet section additionally pins the session-affinity invariant."""
+    with open(args.serving) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SERVING_SCHEMA:
+        print(f"perf_gate: unsupported serving schema "
+              f"{doc.get('schema')!r} (want {SERVING_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    floors_path = args.floors or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf_budgets.json")
+    with open(floors_path) as f:
+        budgets = json.load(f)
+    floor = {m["name"]: float(m["budget"])
+             for m in budgets.get("metrics", [])}
+    problems = []
+    checks = []
+
+    def _check(name, key, bound, strict):
+        try:
+            value = _dig(doc, key)
+        except KeyError:
+            problems.append(f"{key} missing from artifact — rerun "
+                            f"bench_serving.py with the section enabled")
+            checks.append({"name": name, "key": key, "floor": bound,
+                           "value": None, "ok": False})
+            return
+        ok = value > bound if strict else value >= bound
+        if not ok:
+            op = ">" if strict else ">="
+            problems.append(f"{key} = {value:.3f}, floor requires "
+                            f"{op} {bound}")
+        checks.append({"name": name, "key": key, "floor": bound,
+                       "value": value, "ok": ok})
+        print(f"perf_gate {'ok' if ok else 'FAIL':>9} {name}: "
+              f"value={value:.3f} floor={bound}", file=sys.stderr)
+
+    _check("serving_prefix_cache_speedup", "prefix.speedup",
+           floor.get("serving_prefix_cache_speedup", 1.3), strict=False)
+    _check("serving_spec_accept_tokens_per_step",
+           "spec.accept_tokens_per_step",
+           floor.get("serving_spec_accept_tokens_per_step", 1.0),
+           strict=True)
+    if "fleet" in doc and not doc["fleet"].get("session_affinity_ok"):
+        problems.append("fleet.session_affinity_ok is false — a session "
+                        "was served by more than one replica")
+    ok = not problems
+    report = {"schema": SERVING_SCHEMA + "+gate",
+              "artifact": os.path.basename(args.serving),
+              "floors": floors_path,
+              "checks": checks,
+              "problems": problems,
+              "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok,
+                      "checked": len(checks)}), flush=True)
+    if not ok:
+        for p in problems:
+            print(f"perf_gate: FAIL — {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--budgets", default=None, metavar="BUDGETS.json",
@@ -318,17 +405,31 @@ def main():
     parser.add_argument("--retune-threshold", type=float, default=1.05,
                         help="online-tune mode: minimum modeled "
                              "retune.best_speedup to pass (default 1.05)")
+    parser.add_argument("--serving", default=None, metavar="SERVING.json",
+                        help="serving-gate mode: bench_serving artifact "
+                             f"(schema {SERVING_SCHEMA}) that must clear "
+                             "the strict serving floors "
+                             "(serving_prefix_cache_speedup, "
+                             "serving_spec_accept_tokens_per_step) from "
+                             "the budgets file")
+    parser.add_argument("--floors", default=None, metavar="BUDGETS.json",
+                        help="serving mode: budgets file the floors are "
+                             "read from (default: tools/perf_budgets.json "
+                             "next to this script)")
     parser.add_argument("--out", default=None, metavar="OUT.json",
                         help="write the gate report/artifact JSON here")
     args = parser.parse_args()
-    modes = [bool(args.budgets), bool(args.planner), bool(args.online_tune)]
+    modes = [bool(args.budgets), bool(args.planner),
+             bool(args.online_tune), bool(args.serving)]
     if sum(modes) != 1:
-        parser.error(
-            "pass exactly one of --budgets, --planner, or --online-tune")
+        parser.error("pass exactly one of --budgets, --planner, "
+                     "--online-tune, or --serving")
     if args.planner:
         return planner_gate(args)
     if args.online_tune:
         return online_tune_gate(args)
+    if args.serving:
+        return serving_gate(args)
     return check_budgets(args)
 
 
