@@ -224,6 +224,96 @@ def lint_resnet_fused(rules: Optional[Sequence[str]] = None,
         raise_on_error=False)]
 
 
+def _moe_train_target():
+    """The MoE LM example's train step at toy size over the 2x4
+    ``("ep", "data")`` mesh: tokens sharded over ``data``, the expert
+    MLPs dispatched over ``ep`` through a planner all-to-all plan
+    (``moe_plan=``), loss differentiated outside the shard_map exactly
+    like the example.  The census spec is the plan itself: the
+    ``census=`` callable compiles ONE ``execute_alltoall`` over the ep
+    axis, so census-drift holds the compiled exchange to
+    ``plan_census_kinds`` of the MoE dispatch plan — expected kinds are
+    DERIVED from the IR, never hand-written."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.planner.compiler import execute_alltoall
+    from chainermn_tpu.planner.ir import PlanTopology
+    from chainermn_tpu.planner.plans import alltoall_plans
+    from chainermn_tpu.utils import shard_map
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        raise RuntimeError(
+            f"moe/train needs 8 devices for the 2x4 ep x data mesh, "
+            f"have {len(devices)}")
+    mesh = Mesh(np.array(devices[:8]).reshape(2, 4), ("ep", "data"))
+    topo = PlanTopology(axes=(("ep", 2),))
+    plan = next(p for p in alltoall_plans(topo)
+                if p.name == "alltoall_flat_bfloat16")
+    model = TransformerLM(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          max_len=32, attention_impl="xla",
+                          moe_experts=4, moe_top_k=2, moe_axis="ep",
+                          moe_plan=plan)
+    toks = jnp.zeros((8, 16), jnp.int32)
+
+    # init inside the SPMD region (router/expert shapes bind the ep axis)
+    params = jax.jit(shard_map(
+        lambda tk: model.init(jax.random.key(0), tk), mesh=mesh,
+        in_specs=P("data"), out_specs=P(), check_vma=False))(toks)
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+
+    def loss_fn(p_, tk):
+        def body(pp, tkk):
+            logits, mut = model.apply(pp, tkk, mutable=["moe_stats"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tkk[:, 1:]).mean()
+            aux = mut["moe_stats"]["block_0"]["aux_loss"][0]
+            return jax.lax.pmean(ce, ("ep", "data")) + 1e-2 * aux
+
+        return shard_map(body, mesh=mesh, in_specs=(P(), P(None, "data")),
+                         out_specs=P(), check_vma=False)(p_, tk)
+
+    @jax.jit
+    def step(p_, s_, tk):
+        l, g = jax.value_and_grad(loss_fn)(p_, tk)
+        updates, s_ = opt.update(g, s_, p_)
+        return optax.apply_updates(p_, updates), s_, l
+
+    # the census program: ONE plan execution over the ep axis — the
+    # exchange the MoE layer rides twice per application
+    buf = jnp.zeros((4, 8, 16), jnp.float32)
+
+    def census_hlo():
+        return jax.jit(shard_map(
+            lambda b: execute_alltoall(plan, topo, b), mesh=mesh,
+            in_specs=P("ep"), out_specs=P("ep"),
+            check_vma=False)).lower(buf).compile().as_text()
+
+    return step, (params, opt_state, toks), plan, census_hlo
+
+
+def lint_moe_train(rules: Optional[Sequence[str]] = None,
+                   hlo: bool = True) -> List[LintReport]:
+    """One report for the MoE transformer train step (2x4 ep x data
+    mesh).  census-drift holds the compiled token exchange to the MoE
+    dispatch plan's derived kinds and per-hop wire dtypes;
+    wire-dtype-mismatch checks the plan's declared bf16 wire actually
+    appears in the step's compiled program; schedule-desync,
+    captured-constant, donation-alias and async-pair run over the full
+    step.  No communicator object is in play (the example drives
+    shard_map directly), so the gradient-probe rule reports as
+    skipped."""
+    step, args, plan, census_hlo = _moe_train_target()
+    return [lint_step(
+        step, *args,
+        name="examples/moe_lm[ep2xdata4]",
+        plan=plan, census=census_hlo,
+        variants={"rank0": (step,) + args, "rank1": (step,) + args},
+        hlo=hlo, rules=rules, raise_on_error=False)]
+
+
 def _serving_decode_target(tp: int = 2):
     """The serving engine's fused prefill+decode forward at toy size,
     tensor-parallel over 2 devices — the jitted program every serving
@@ -368,6 +458,14 @@ ENTRY_POINTS: Dict[str, dict] = {
                 "kernels at every norm boundary (census + gradient probe "
                 "through the custom VJP + desync variants)",
     },
+    "moe/train": {
+        "fn": lint_moe_train,
+        "flavors": None,
+        "help": "MoE transformer train step over the 2x4 ep x data mesh: "
+                "census-drift holds the compiled token exchange to the "
+                "dispatch plan's derived kinds and wire dtypes (plus "
+                "schedule/captured-constant/donation/async rules)",
+    },
     "serving/decode": {
         "fn": lint_serving_decode,
         "flavors": None,
@@ -403,5 +501,6 @@ def lint_entry_point(name: str, flavors: Optional[Sequence[str]] = None,
 
 
 __all__ = ["ENTRY_POINTS", "MNIST_FLAVORS", "lint_entry_point",
-           "lint_long_context", "lint_mnist", "lint_resnet_fused",
-           "lint_serving_decode", "lint_serving_weights"]
+           "lint_long_context", "lint_mnist", "lint_moe_train",
+           "lint_resnet_fused", "lint_serving_decode",
+           "lint_serving_weights"]

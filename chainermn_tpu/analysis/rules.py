@@ -594,7 +594,8 @@ def _wire_dtype_mismatch(ctx) -> List[Finding]:
                                   f"({st.op}) wire_dtype {wire!r}"))
         observed = [op.dtype for op in ctx.hlo_schedule
                     if op.kind in ("all-reduce", "reduce-scatter",
-                                   "all-gather", "collective-permute")]
+                                   "all-gather", "collective-permute",
+                                   "all-to-all")]
         # CPU XLA promotes bf16 collectives to f32 (the wire casts fuse
         # AROUND the all-reduce), so on the lint preflight host the wire
         # dtype may never appear ON a collective even when the cast seam

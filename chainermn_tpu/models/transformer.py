@@ -87,6 +87,7 @@ class Block(nn.Module):
     moe_top_k: int = 1
     moe_axis: Any = "ep"
     moe_capacity: Optional[int] = None
+    moe_plan: Any = None          # all-to-all Plan for the MoE exchanges
     tp_size: int = 1              # tensor-parallel ways (serving)
     tp_axis: Any = None           # mesh axis for the row-parallel psums
 
@@ -142,7 +143,7 @@ class Block(nn.Module):
                 hidden=4 * d_model, axis_name=self.moe_axis,
                 capacity=self.moe_capacity, dtype=self.dtype,
                 top_k=self.moe_top_k, num_experts=self.moe_experts,
-                with_stats=True, name="moe")(h)
+                with_stats=True, plan=self.moe_plan, name="moe")(h)
             self.sow("moe_stats", "aux_loss", stats["aux_loss"])
             self.sow("moe_stats", "overflow_fraction",
                      stats["overflow_fraction"])
@@ -181,6 +182,7 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 1
     moe_axis: Any = "ep"
     moe_capacity: Optional[int] = None
+    moe_plan: Any = None          # all-to-all Plan for the MoE exchanges
     tp_size: int = 1              # tensor-parallel ways (serving)
     tp_axis: Any = None
 
@@ -213,6 +215,7 @@ class TransformerLM(nn.Module):
                       moe_experts=self.moe_experts,
                       moe_top_k=self.moe_top_k, moe_axis=self.moe_axis,
                       moe_capacity=self.moe_capacity,
+                      moe_plan=self.moe_plan,
                       tp_size=self.tp_size, tp_axis=self.tp_axis,
                       name=f"block_{i}")(x, attend=blk_attend)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,

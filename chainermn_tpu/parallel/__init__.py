@@ -34,6 +34,7 @@ from chainermn_tpu.parallel.tensor import (
 from chainermn_tpu.parallel.expert import (
     ExpertParallelMLP,
     moe_apply,
+    moe_plan_topology,
 )
 from chainermn_tpu.parallel.buckets import (
     BucketAssignment,
@@ -58,6 +59,7 @@ __all__ = [
     "TensorParallelMLP",
     "describe_buckets",
     "moe_apply",
+    "moe_plan_topology",
     "partition_buckets",
     "DATA_AXES",
     "FsdpMeta",

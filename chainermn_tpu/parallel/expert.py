@@ -42,11 +42,25 @@ from jax import lax
 from chainermn_tpu.utils import axis_size as _axis_size
 
 
+def moe_plan_topology(axis_name):
+    """The :class:`~chainermn_tpu.planner.ir.PlanTopology` of the MoE
+    exchange axes: one axis per mesh axis name, sizes read from the
+    bound SPMD region (static at trace time).  ``axis_name`` may be one
+    name (flat ep axis) or an (inter, intra) tuple — the LAST name is
+    the ICI axis, matching the planner convention."""
+    from chainermn_tpu.planner.ir import PlanTopology
+    names = (tuple(axis_name) if isinstance(axis_name, (tuple, list))
+             else (axis_name,))
+    return PlanTopology(axes=tuple(
+        (str(n), int(_axis_size(n))) for n in names))
+
+
 def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
               capacity: Optional[int] = None, top_k: int = 1,
               num_experts: Optional[int] = None,
               normalize_gates: Optional[bool] = None,
-              return_stats: bool = False):
+              return_stats: bool = False,
+              plan=None, plan_topology=None, plan_obs=None):
     """Route local tokens [N, D] to mesh-distributed experts; return [N, D].
 
     ``gate_logits``: [N, E].  E defaults to the gate width and must be a
@@ -65,6 +79,16 @@ def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
 
     With ``return_stats=True`` returns ``(y, stats)`` — see module
     docstring for the stats contract.
+
+    ``plan`` routes the two exchanges through the collective planner
+    (:func:`~chainermn_tpu.planner.compiler.execute_alltoall`): an
+    all-to-all :class:`~chainermn_tpu.planner.ir.Plan` from the
+    ``alltoall_plans`` zoo — flat (bit-exact with the default raw
+    ``lax.all_to_all`` path), hierarchical ICI+DCN, or narrow-DCN-wire.
+    ``axis_name`` may then be an (inter, intra) tuple of mesh axes;
+    ``plan_topology`` overrides the derived topology and ``plan_obs``
+    (``observability.spans.get_plan_obs()``) turns on per-hop
+    ``plan_stage`` spans.  ``plan=None`` is today's raw path, untouched.
     """
     p = _axis_size(axis_name)
     n, d = x.shape
@@ -105,16 +129,22 @@ def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
 
     # experts are laid out contiguously per owner device, so grouping the
     # E axis as [P, E/P * C] makes all_to_all ship each device its block
-    recv = lax.all_to_all(send.reshape(p, epd * c, d), axis_name,
-                          split_axis=0, concat_axis=0, tiled=True)
+    if plan is None:
+        exchange = lambda b: lax.all_to_all(
+            b, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    else:
+        from chainermn_tpu.planner.compiler import execute_alltoall
+        topo = (plan_topology if plan_topology is not None
+                else moe_plan_topology(axis_name))
+        exchange = lambda b: execute_alltoall(plan, topo, b, pobs=plan_obs)
+    recv = exchange(send.reshape(p, epd * c, d))
     recv = recv.reshape(p, epd, c, d).transpose(1, 0, 2, 3)  # [E/P, P, C, D]
     if epd == 1:
         out = expert_fn(recv.reshape(p * c, d))
     else:
         out = expert_fn(recv.reshape(epd, p * c, d))
     out = out.reshape(epd, p, c, d).transpose(1, 0, 2, 3)
-    back = lax.all_to_all(out.reshape(p, epd * c, d), axis_name,
-                          split_axis=0, concat_axis=0, tiled=True)
+    back = exchange(out.reshape(p, epd * c, d))
     back = back.reshape(e, c, d)
 
     # combine: sum kept choices weighted by gate prob; all-dropped tokens
@@ -165,6 +195,9 @@ class ExpertParallelMLP(nn.Module):
     top_k: int = 1
     num_experts: Optional[int] = None   # default: one expert per device
     with_stats: bool = False
+    #: all-to-all Plan routing the dispatch/combine exchanges through
+    #: the collective planner (None = the raw flat path, bit-exact)
+    plan: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -205,15 +238,20 @@ class ExpertParallelMLP(nn.Module):
             return (jnp.einsum("eah,ehd->ead", h, down_kl.astype(self.dtype))
                     + down_bl[:, None].astype(self.dtype))
 
+        plan_obs = None
+        if self.plan is not None:
+            from chainermn_tpu.observability.spans import get_plan_obs
+            plan_obs = get_plan_obs()
         shape = x.shape
         flat = x.reshape(-1, d)
         res = moe_apply(expert_fn, router(flat), flat, self.axis_name,
                         capacity=self.capacity, top_k=self.top_k,
-                        num_experts=e, return_stats=self.with_stats)
+                        num_experts=e, return_stats=self.with_stats,
+                        plan=self.plan, plan_obs=plan_obs)
         if self.with_stats:
             y, stats = res
             return y.reshape(shape), stats
         return res.reshape(shape)
 
 
-__all__ = ["ExpertParallelMLP", "moe_apply"]
+__all__ = ["ExpertParallelMLP", "moe_apply", "moe_plan_topology"]

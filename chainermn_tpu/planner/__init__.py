@@ -23,6 +23,7 @@ from chainermn_tpu.planner.autotune import (
 )
 from chainermn_tpu.planner.compiler import (
     LINK_CLASS,
+    execute_alltoall,
     execute_plan,
     init_plan_compression_states,
     plan_census_kinds,
@@ -60,6 +61,7 @@ from chainermn_tpu.planner.ir import (
 from chainermn_tpu.planner.plans import (
     FLAVOR_NAMES,
     STRIPE_RATIOS,
+    alltoall_plans,
     broadcast_plans,
     candidate_plans,
     flavor_plan,
@@ -87,10 +89,12 @@ __all__ = [
     "Stage",
     "StageGroup",
     "active_plan_table_meta",
+    "alltoall_plans",
     "autotune_from_rows",
     "broadcast_plans",
     "clear_active_plan_table",
     "candidate_plans",
+    "execute_alltoall",
     "execute_plan",
     "flavor_plan",
     "get_active_plan_table",

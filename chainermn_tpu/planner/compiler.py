@@ -387,6 +387,11 @@ def _run_stages_flat(plan: Plan, topology: PlanTopology, buf,
             perm = [(i, (i + 1) % n) for i in range(n)]
             buf = _with_wire(buf, st.wire_dtype,
                              lambda b: lax.ppermute(b, axes[0], perm))
+        elif st.op == "all-to-all":
+            raise PlanError(
+                f"plan {plan.name!r}: all-to-all stages lower through "
+                "execute_alltoall (a block exchange over [P, ...] "
+                "buffers), not the gradient-mean executor")
         else:  # pragma: no cover — ir validation rejects unknown ops
             raise PlanError(f"unknown stage op {st.op!r}")
         _stage_hook(pobs, plan, topology, i, st, buf, "end", group=group)
@@ -548,6 +553,150 @@ def execute_plan(plan: Plan, comm, grads, *, states: Optional[Dict] = None):
     return result
 
 
+def _exchange_hook(pobs, plan: Plan, topology: PlanTopology, i: int,
+                   st: Stage, buf, edge: str, group: Optional[int] = None):
+    """Per-stage span edge for an exchange stage: the payload is the
+    WHOLE block buffer (every element is shipped or kept in place), so
+    the wire bytes price ``buf.size`` elements at the stage's wire
+    width — not the leading dim the flat-gradient hook assumes."""
+    if pobs is None:
+        return
+    wb = _stage_wire_elem_bytes(plan, st, float(buf.size),
+                                jnp.dtype(buf.dtype).itemsize)
+    _stage_hook(pobs, plan, topology, i, st, buf.reshape(-1), edge,
+                wire_bytes=wb, group=group)
+
+
+def _run_alltoall_chain(plan: Plan, topology: PlanTopology, stages, buf,
+                        pobs=None, group: Optional[int] = None):
+    """Lower one exchange chain over one ``[P, ...]`` block buffer.
+
+    Two canonical decompositions (the zoo ``plans.alltoall_plans``
+    emits):
+
+    * **flat** — one stage over scope ``all`` (or ``intra`` on a
+      single-axis topology): one tiled ``lax.all_to_all`` over the
+      scope's axes, blocks indexed by destination global rank in
+      topology (inter-major) order.
+    * **hierarchical** — ``intra`` then ``inter``: the ICI hop regroups
+      blocks by destination intra coordinate (each intra peer ``j``
+      collects the node's traffic for every ``(i, j)`` target), a local
+      transpose re-majors them by destination host, and the DCN hop
+      ships each host its aggregate — at the stage's (narrow)
+      ``wire_dtype``.  The composed exchange lands blocks in source
+      global-rank order, IDENTICAL to the flat exchange (pinned
+      bit-exact in ``tests/test_moe_plan.py``).
+    """
+    emitted = [(i, st) for i, st in enumerate(stages)
+               if topology.scope_axes(st.scope)]
+    scopes = tuple(st.scope for _, st in emitted)
+    if int(buf.shape[0]) != topology.size:
+        raise PlanError(
+            f"plan {plan.name!r}: exchange buffer leading dim "
+            f"{int(buf.shape[0])} != topology size {topology.size} — "
+            "all-to-all buffers carry one block per destination rank")
+    if scopes in (("all",), ("intra",)):
+        if scopes == ("intra",) and topology.inter_size != 1:
+            raise PlanError(
+                f"plan {plan.name!r}: an intra-only exchange on a "
+                f"multi-host topology ({topology.key()}) is not a full "
+                "all-to-all — use scope 'all' or the hierarchical "
+                "intra+inter chain")
+        i, st = emitted[0]
+        axes = topology.scope_axes(st.scope)
+        _exchange_hook(pobs, plan, topology, i, st, buf, "begin",
+                       group=group)
+        buf = _with_wire(
+            buf, st.wire_dtype,
+            lambda b: lax.all_to_all(b, _axis_arg(axes), 0, 0, tiled=True))
+        _exchange_hook(pobs, plan, topology, i, st, buf, "end",
+                       group=group)
+        return buf
+    if scopes != ("intra", "inter"):
+        raise PlanError(
+            f"plan {plan.name!r}: unsupported exchange chain over scopes "
+            f"{scopes}; supported: one flat stage (scope 'all') or the "
+            "hierarchical 'intra' then 'inter' pair")
+    (ii, intra_st), (ji, inter_st) = emitted
+    intra_axis = topology.scope_axes("intra")[0]
+    inter_axes = topology.scope_axes("inter")
+    isz, jsz = topology.inter_size, topology.intra_size
+    rest = tuple(buf.shape[1:])
+    # [P(dest rank, inter-major), ...] -> intra-major so the ICI hop
+    # splits by destination intra coordinate
+    x = buf.reshape((isz, jsz) + rest)
+    x = jnp.moveaxis(x, 1, 0).reshape((jsz * isz,) + rest)
+    _exchange_hook(pobs, plan, topology, ii, intra_st, x, "begin",
+                   group=group)
+    x = _with_wire(
+        x, intra_st.wire_dtype,
+        lambda b: lax.all_to_all(b, intra_axis, 0, 0, tiled=True))
+    _exchange_hook(pobs, plan, topology, ii, intra_st, x, "end",
+                   group=group)
+    # x[b'*I + i] = block from intra peer b' destined (i, self_j);
+    # re-major by destination host for the DCN hop
+    x = x.reshape((jsz, isz) + rest)
+    x = jnp.moveaxis(x, 1, 0).reshape((isz * jsz,) + rest)
+    _exchange_hook(pobs, plan, topology, ji, inter_st, x, "begin",
+                   group=group)
+    x = _with_wire(
+        x, inter_st.wire_dtype,
+        lambda b: lax.all_to_all(b, _axis_arg(inter_axes), 0, 0,
+                                 tiled=True))
+    _exchange_hook(pobs, plan, topology, ji, inter_st, x, "end",
+                   group=group)
+    # x[a'*J + b'] = block from source (a', b') — source global-rank
+    # order, exactly the flat exchange's output layout
+    return x
+
+
+def execute_alltoall(plan: Plan, topology: PlanTopology, buf, *,
+                     pobs=None):
+    """Run ``plan`` as a block exchange over ``buf`` — the MoE
+    dispatch/combine seam (``parallel/expert.moe_apply(plan=...)``).
+
+    ``buf`` is a ``[P, ...]`` buffer inside an SPMD region whose mesh
+    axes match ``topology`` (one block per destination global rank,
+    topology axis order = mesh order, inter-major).  Returns the
+    exchanged buffer with blocks indexed by SOURCE global rank — exactly
+    ``lax.all_to_all(..., split_axis=0, concat_axis=0, tiled=True)``
+    semantics over the combined axes, whatever decomposition the plan
+    picked.  ``pobs`` (``spans.get_plan_obs()``) brackets every emitted
+    hop with ``plan_stage`` begin/end edges, so the ICI and DCN legs of
+    one dispatch are separate attribution spans.
+
+    A striped plan (``plan.groups``) splits the buffer's SECOND dim (the
+    within-block payload) at the group ratio boundaries and runs each
+    group's chain over its slice — the chains are data-independent, so
+    XLA interleaves them, same as the striped allreduce lowering.
+    """
+    if plan.packing != "flat":
+        raise PlanError(
+            f"plan {plan.name!r}: all-to-all requires flat packing")
+    if plan.groups is None:
+        return _run_alltoall_chain(plan, topology, plan.stages, buf,
+                                   pobs=pobs)
+    if buf.ndim < 2:
+        raise PlanError(
+            f"plan {plan.name!r}: a striped exchange splits the "
+            "within-block payload — the buffer needs a second dim")
+    lens = plan_group_lengths(plan, int(buf.shape[1]))
+    if len(lens) == 1:
+        return _run_alltoall_chain(plan, topology, plan.groups[0].stages,
+                                   buf, pobs=pobs, group=0)
+    parts = []
+    off = 0
+    for g, ln in enumerate(lens):
+        seg = lax.slice_in_dim(buf, off, off + ln, axis=1)
+        off += ln
+        if ln:
+            seg = _run_alltoall_chain(plan, topology,
+                                      plan.groups[g].stages, seg,
+                                      pobs=pobs, group=g)
+        parts.append(seg)
+    return jnp.concatenate(parts, axis=1)
+
+
 #: stage op -> HLO collective kind its default lowering compiles to
 _CENSUS_KIND = {
     "all-reduce": "all-reduce",
@@ -556,6 +705,7 @@ _CENSUS_KIND = {
     "all-gather": "all-reduce",
     "multicast": "all-reduce",
     "p2p": "collective-permute",
+    "all-to-all": "all-to-all",
 }
 
 
@@ -680,6 +830,11 @@ def _chain_stage_costs(plan: Plan, stages, topology: PlanTopology,
             moved = 2.0 * stage_bytes * (size - 1) / max(size, 1)
         elif st.op == "p2p":
             moved = stage_bytes
+        elif st.op == "all-to-all":
+            # tiled exchange: each device keeps its own 1/size block and
+            # ships the rest — (size-1)/size of the stage payload per
+            # device, shape-preserving (frac unchanged)
+            moved = stage_bytes * (size - 1) / max(size, 1)
         else:  # pragma: no cover
             moved = stage_bytes
         out.append((st.scope, moved))
@@ -785,7 +940,8 @@ def plan_dcn_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
     return float(costs.get("inter", 0.0) + costs.get("all", 0.0))
 
 
-__all__ = ["LINK_CLASS", "execute_plan", "init_plan_compression_states",
+__all__ = ["LINK_CLASS", "execute_alltoall", "execute_plan",
+           "init_plan_compression_states",
            "plan_census_kinds", "plan_compressed_hops", "plan_dcn_bytes",
            "plan_group_lengths", "plan_link_bytes", "plan_modeled_time_s",
            "plan_stage_lengths", "plan_wire_bytes", "plan_wire_dtypes"]

@@ -35,6 +35,17 @@ Stage vocabulary (the HiCCL/multicast stage set the ROADMAP names):
     one ring hop (``ppermute`` by +1) over the scope axis — the stage
     vocabulary seam per-hop pipelines (DynamiQ, ROADMAP item 2) build
     on.
+``all-to-all``
+    tiled block exchange over the scope's axes (MoE token dispatch /
+    combine, Ulysses head exchange): block ``d`` of device ``r``'s
+    ``[P, ...]`` buffer ships to device ``d``.  Shape-preserving, so it
+    stacks freely into the hierarchical two-hop form (``intra`` then
+    ``inter``) and per-stage ``wire_dtype`` is legal — the DCN hop of a
+    hierarchical exchange rides a narrow wire.  Exchange chains lower
+    through :func:`~chainermn_tpu.planner.compiler.execute_alltoall`
+    (block buffers), not the gradient-mean path, and must be
+    homogeneous: mixing all-to-all with reduction stages in one chain
+    has no defined block layout.
 """
 
 from __future__ import annotations
@@ -46,7 +57,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 #: stage op kinds (the plan vocabulary)
 STAGE_OPS = ("all-reduce", "reduce-scatter", "all-gather", "multicast",
-             "p2p")
+             "p2p", "all-to-all")
 
 #: symbolic axis scopes a stage communicates over.  "intra" is the last
 #: (ICI) data axis, "inter" the leading (DCN-ish) axes, "all" every data
@@ -281,10 +292,27 @@ def _validate_chain(plan_name: str, stages: Sequence[Stage],
     """Shard-stack validation of one stage chain (a plain plan's stages
     or one concurrent group's)."""
     at = f" in {where}" if where else ""
-    shard_stack = []
     for i, st in enumerate(stages):
         if not isinstance(st, Stage):
             raise PlanError(f"stage {i}{at} is not a Stage: {st!r}")
+    ops = {st.op for st in stages}
+    if "all-to-all" in ops:
+        # exchange chains are homogeneous: interleaving a reduction with
+        # the block exchange has no defined block layout, and the
+        # exchange executor (compiler.execute_alltoall) runs over
+        # [P, ...] block buffers, which only exist under flat packing
+        if ops != {"all-to-all"}:
+            raise PlanError(
+                f"plan {plan_name!r}{at}: an all-to-all chain must be "
+                f"all-to-all stages only, got ops {sorted(ops)}")
+        if packing != "flat":
+            raise PlanError(
+                f"plan {plan_name!r}{at}: all-to-all requires flat "
+                "packing — the exchange runs over a [P, ...] block "
+                "buffer")
+        return
+    shard_stack = []
+    for i, st in enumerate(stages):
         if st.op == "reduce-scatter":
             if packing != "flat":
                 raise PlanError(

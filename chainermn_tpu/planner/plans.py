@@ -200,10 +200,88 @@ def broadcast_plans(topology: PlanTopology,
     return out
 
 
+def _a2a(scope: str, wire_dtype: Optional[str] = None) -> Stage:
+    return Stage(op="all-to-all", scope=scope, wire_dtype=wire_dtype)
+
+
+def _hier_a2a(intra_wire: Optional[str] = None,
+              inter_wire: Optional[str] = None) -> tuple:
+    """The hierarchical exchange chain: ICI regroup hop, then the DCN
+    hop — the only leg worth a narrow wire (``inter_wire``)."""
+    return (_a2a("intra", intra_wire), _a2a("inter", inter_wire))
+
+
+#: narrow wires the all-to-all zoo tries on the DCN hop.  Exchange hops
+#: move values instead of summing them, so the per-hop knob is a plain
+#: wire CAST (bf16 / fp8), not the integer-code compressors — in-wire
+#: summed int8 codes have no meaning on a hop with no reduction.
+ALLTOALL_DCN_WIRES = ("bfloat16", "float8_e4m3fn")
+
+
+def alltoall_plans(topology: PlanTopology,
+                   wire_dtypes: tuple = ("bfloat16",),
+                   dcn_wires: tuple = ALLTOALL_DCN_WIRES,
+                   stripe_ratios: tuple = ()) -> List[Plan]:
+    """The all-to-all (MoE dispatch) candidate zoo for one topology.
+
+    * ``alltoall_flat`` — one exchange over every data axis (today's raw
+      ``lax.all_to_all`` path as plan data; scope ``all`` prices at DCN
+      rates, which is exactly the flat path's problem on multi-host
+      topologies), plus reduced-wire variants.
+    * ``alltoall_hierarchical`` — ICI regroup hop + DCN hop (HiCCL's
+      composition argument applied to the exchange), full precision.
+    * ``alltoall_hier_<wd>_dcn`` — hierarchical with ONLY the DCN hop on
+      a narrow wire (bf16 / fp8 cast): the DynamiQ-flavored variant the
+      ``moe_alltoall_dcn_bytes`` budget tracks.
+    * ``alltoall_hier_<wd>`` — both hops on the reduced wire.
+    * ``alltoall_striped_rNN`` — PR 11 composition: a full-precision
+      stripe and a narrow-DCN stripe exchanging concurrent slices of the
+      block payload.
+
+    ``PlanTable`` tunes over these per (topology, dtype, size) exactly
+    like the allreduce zoo — same sweep row schema, same bucket ladder.
+    """
+    out: List[Plan] = [Plan(name="alltoall_flat", packing="flat",
+                            stages=(_a2a("all"),))]
+    for wd in wire_dtypes:
+        out.append(Plan(name=f"alltoall_flat_{wd}", packing="flat",
+                        stages=(_a2a("all", wd),)))
+    if len(topology.axes) >= 2 and topology.inter_size > 1:
+        out.append(Plan(name="alltoall_hierarchical", packing="flat",
+                        stages=_hier_a2a()))
+        for wd in dcn_wires:
+            out.append(Plan(name=f"alltoall_hier_{wd}_dcn",
+                            packing="flat",
+                            stages=_hier_a2a(inter_wire=wd)))
+        for wd in wire_dtypes:
+            out.append(Plan(name=f"alltoall_hier_{wd}", packing="flat",
+                            stages=_hier_a2a(wd, wd)))
+        narrow = dcn_wires[0] if dcn_wires else None
+        for r in stripe_ratios:
+            r = float(r)
+            if not 0.0 < r < 1.0 or narrow is None:
+                continue
+            out.append(Plan(
+                name=f"alltoall_striped_r{int(round(r * 100)):02d}",
+                packing="flat",
+                groups=(StageGroup(stages=_hier_a2a(), ratio=r,
+                                   name="full"),
+                        StageGroup(stages=_hier_a2a(inter_wire=narrow),
+                                   ratio=round(1.0 - r, 12),
+                                   name="narrow"))))
+    seen: Dict[str, Plan] = {}
+    for p in out:
+        d = p.to_dict()
+        d.pop("name", None)
+        seen.setdefault(repr(d), p)
+    return list(seen.values())
+
+
 def candidate_plans(topology: PlanTopology,
                     wire_dtypes: tuple = ("bfloat16",),
                     dcn_compressors: tuple = DCN_COMPRESSORS,
-                    stripe_ratios: tuple = ()) -> List[Plan]:
+                    stripe_ratios: tuple = (),
+                    op: str = "all-reduce") -> List[Plan]:
     """The autotuner's search space for one topology.
 
     Always includes every fixed flavor legal on the topology (so the
@@ -220,7 +298,17 @@ def candidate_plans(topology: PlanTopology,
     inter size can carry int8 codes, plus the uncompressed pipelining
     stripe), so the autotuner tunes the split ratio the same way it
     tunes wire dtypes.
+
+    ``op`` selects the collective family: the default ``"all-reduce"``
+    zoo above, or ``"all-to-all"`` for the exchange zoo
+    (:func:`alltoall_plans` — MoE dispatch decompositions tuned through
+    the same :class:`~chainermn_tpu.planner.autotune.PlanTable`).
     """
+    if op == "all-to-all":
+        return alltoall_plans(topology, wire_dtypes=wire_dtypes,
+                              stripe_ratios=stripe_ratios)
+    if op != "all-reduce":
+        raise ValueError(f"unknown candidate-plan op {op!r}")
     multi_axis = len(topology.axes) >= 2 and topology.inter_size >= 1
     out: List[Plan] = [flavor_plan("naive"), flavor_plan("flat"),
                        flavor_plan("xla")]
@@ -271,7 +359,7 @@ def candidate_plans(topology: PlanTopology,
     return list(seen.values())
 
 
-__all__ = ["DCN_COMPRESSORS", "FLAVOR_NAMES", "STRIPE_RATIOS",
-           "broadcast_plans", "candidate_plans",
-           "compressed_two_dimensional", "flavor_plan", "multicast_plan",
-           "striped_plan"]
+__all__ = ["ALLTOALL_DCN_WIRES", "DCN_COMPRESSORS", "FLAVOR_NAMES",
+           "STRIPE_RATIOS", "alltoall_plans", "broadcast_plans",
+           "candidate_plans", "compressed_two_dimensional", "flavor_plan",
+           "multicast_plan", "striped_plan"]

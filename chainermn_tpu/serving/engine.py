@@ -23,6 +23,17 @@ sharded over its kv heads, and the blocks psum their row-parallel
 outputs (:class:`chainermn_tpu.models.transformer.Block`), so the logits
 — and therefore the greedy samples — are replicated across the axis.
 
+With ``ep_size > 1`` (MoE models only) the mesh grows an ``"ep"`` axis
+— ``(tp, ep)`` devices, axes ``("tp", "ep")`` — and every block's MoE
+MLP dispatches its tokens over ``"ep"``: each device hosts
+``moe_experts / ep_size`` experts and the two all-to-all exchanges ride
+the step's one shard_map.  Tokens and gate math are replicated over the
+axis, so the logits stay replicated (ep=2 decode is bit-identical to
+ep=1) while expert FLOPs split ``ep`` ways.  ``moe_plan`` routes the
+exchanges through the collective planner
+(:func:`chainermn_tpu.planner.compiler.execute_alltoall`) so the
+dispatch is a census-visible plan stage.
+
 Wall-clock is only ever read on the host (latency bookkeeping); nothing
 traced depends on time.
 """
@@ -53,6 +64,8 @@ class ServingConfig:
     eos_id: Optional[int] = None
     policy: str = "continuous"    # or "static" (benchmark baseline)
     tp_size: int = 1              # tensor-parallel ways
+    ep_size: int = 1              # expert-parallel ways (MoE models)
+    moe_plan: Any = None          # all-to-all Plan for the MoE exchanges
     cache_dtype: Any = jnp.float32
     keep_logits: bool = False     # stash last-position logits per step
     prefix_cache: bool = False    # copy-on-write prompt-prefix sharing
@@ -135,28 +148,57 @@ class InferenceEngine:
             policy=cfg.policy, prefix_cache=cfg.prefix_cache)
 
         tp = cfg.tp_size
-        if tp > 1:
+        ep = cfg.ep_size
+        if ep > 1:
+            if not model.moe_experts:
+                raise ValueError(
+                    f"ep_size ({ep}) > 1 requires an MoE model "
+                    f"(moe_experts > 0)")
+            if model.moe_experts % ep:
+                raise ValueError(
+                    f"ep_size ({ep}) must divide moe_experts "
+                    f"({model.moe_experts})")
+            if cfg.spec_k:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) is not supported "
+                    "with ep_size > 1")
+        # MoE models always take the mesh path — their expert dispatch
+        # needs the "ep" axis bound even at ep_size=1 (a 1-wide axis)
+        moe = bool(getattr(model, "moe_experts", 0))
+        if tp > 1 or ep > 1 or moe:
             from chainermn_tpu.serving.weights import shard_params_tp
 
             if n_kv % tp:
                 raise ValueError(
                     f"tp_size ({tp}) must divide n_kv_heads ({n_kv})")
             devs = jax.devices()
-            if len(devs) < tp:
+            if len(devs) < tp * ep:
                 raise ValueError(
-                    f"tp_size {tp} exceeds the {len(devs)} visible "
-                    f"devices")
-            self._mesh = jax.sharding.Mesh(np.array(devs[:tp]), ("tp",))
-            self._model_tp = model.clone(tp_size=tp, tp_axis="tp")
+                    f"tp_size {tp} x ep_size {ep} exceeds the "
+                    f"{len(devs)} visible devices")
+            if ep > 1 or moe:
+                self._mesh = jax.sharding.Mesh(
+                    np.array(devs[:tp * ep]).reshape(tp, ep),
+                    ("tp", "ep"))
+                self._model_tp = model.clone(
+                    tp_size=tp, tp_axis="tp" if tp > 1 else None,
+                    moe_axis="ep", moe_plan=cfg.moe_plan)
+            else:
+                self._mesh = jax.sharding.Mesh(np.array(devs[:tp]),
+                                               ("tp",))
+                self._model_tp = model.clone(tp_size=tp, tp_axis="tp")
             # Re-place everything onto THIS engine's tp mesh: params may
             # arrive committed elsewhere (e.g. the run_spmd output of
             # broadcast_inference_params lives on the communicator's
             # full-device mesh), and jit refuses mixed device sets.
             tp_sharding = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec("tp"))
-            self._params = jax.device_put(shard_params_tp(
-                params, tp, n_heads=model.n_heads, n_kv_heads=n_kv),
-                tp_sharding)
+            sliced = shard_params_tp(
+                params, tp, n_heads=model.n_heads, n_kv_heads=n_kv) \
+                if tp > 1 else jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (1,) + x.shape),
+                    params)
+            self._params = jax.device_put(sliced, tp_sharding)
             cache = _kv.init_kv_cache(
                 model.n_layers, cfg.num_pages, cfg.page_size,
                 n_kv // tp, head_dim, cfg.cache_dtype)

@@ -243,6 +243,38 @@ run 0 "$OUT/PLANNER_GATE_STRIPED_$ROUND.json" \
             --require-striped 2 \
             --out '$OUT/PLANNER_GATE_STRIPED_$ROUND.json'"
 
+# ---- MoE: matched-loss leg + all-to-all dispatch planner gate ---------
+# FLOP-matched comparison first (top_k=1 expert MLP vs the dense MLP of
+# identical width: same per-token FLOPs, E x parameters): the MoE run
+# must reach a final loss at or below the dense baseline on the
+# mode-mixture LM task.  The artifact feeds perf_gate --moe-bench below.
+run 0 "$OUT/MOE_BENCH_$ROUND.json" \
+    "FLOP-matched MoE vs dense LM on the mode-mixture task: MoE final loss must be <= dense (defaults bake the validated capacity-bound config)" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_moe.py --out '$OUT/MOE_BENCH_$ROUND.json' \
+            > /dev/null"
+
+# All-to-all dispatch planner gate: sweep the all-to-all plan zoo (flat,
+# hierarchical intra->re-major->inter, bf16/fp8 narrow-DCN wires,
+# striped) under modeled heterogeneous links, then require (a) non-flat
+# plans to beat alltoall_flat in >= 2 cells, (b) >= 1.8x bf16-DCN byte
+# shrink at the largest payload (feeds the moe_alltoall_dcn_bytes
+# budget), and (c) the MOE_BENCH matched-loss check.  On a slice,
+# re-run WITHOUT the env override and WITHOUT --link-gbps to tune the
+# dispatch on measured ICI/DCN (docs/moe.md "Tuned dispatch").
+run 0 "$OUT/PLANNER_GATE_ALLTOALL_$ROUND.json" \
+    "MoE all-to-all planner gate: sweep the dispatch plan zoo under modeled heterogeneous links, require hierarchical wins in >= 2 cells + >= 1.8x bf16-DCN shrink + matched loss" -- \
+    bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        $PY_TPU benchmarks/bench_moe.py \
+            --sweep '$OUT/ALLTOALL_SWEEP_$ROUND.json' \
+            --intra-size 4 --link-gbps ici=0.2,dcn=0.01 \
+            --iters 10 --warmup 2 > /dev/null \
+        && $PY_TPU tools/perf_gate.py \
+            --moe '$OUT/ALLTOALL_SWEEP_$ROUND.json' \
+            --moe-bench '$OUT/MOE_BENCH_$ROUND.json' \
+            --table '$OUT/PLAN_TABLE_ALLTOALL_$ROUND.json' \
+            --out '$OUT/PLANNER_GATE_ALLTOALL_$ROUND.json'"
+
 # ---- online autotuning: replay degraded-link spans -> retune gate -----
 # Attribution-closed loop, offline leg: feed the committed degraded-DCN
 # span dump (healthy ~16 GB/s ICI stage timings, ~0.5 GB/s DCN stage

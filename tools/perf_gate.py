@@ -56,9 +56,28 @@ that invokes them goes red instead of silently recording a slower repo:
    beat one-token-per-step decode), and — when a fleet section is
    present — session affinity unbroken.
 
+5. MoE all-to-all gate::
+
+       python tools/perf_gate.py --moe ALLTOALL_SWEEP.json \
+           --moe-bench MOE_BENCH.json --table plan_table.json \
+           --out PLANNER_GATE_ALLTOALL.json
+
+   Consumes a ``bench_moe --sweep`` artifact (same ``allreduce_sweep/v1``
+   row schema, all-to-all plan zoo) and PASSES only if (a) a non-flat
+   plan strictly beats ``alltoall_flat`` in at least
+   ``--require-alltoall-wins`` cells (default 2) — hierarchical dispatch
+   must pay for itself, (b) the bf16-DCN dispatch shrinks DCN bytes by
+   at least ``--require-dcn-shrink`` (default 1.8x) at the largest swept
+   payload, and (c) when ``--moe-bench`` is given, the FLOP-matched MoE
+   model reaches a final loss at or below the dense baseline.  Writes
+   the tuned all-to-all plan table for the ``plan=`` seam of
+   ``moe_apply``.  (The legacy fixed-flavor baseline in
+   ``autotune_from_rows`` only knows all-reduce names, so this mode
+   computes its own tuned-vs-flat comparison.)
+
 Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER,
-ONLINE_TUNE and SERVING_FLEET legs; see docs/collective_planner.md and
-docs/serving.md.
+ONLINE_TUNE, SERVING_FLEET and PLANNER_GATE_ALLTOALL legs; see
+docs/collective_planner.md, docs/moe.md and docs/serving.md.
 """
 
 import argparse
@@ -73,6 +92,9 @@ BUDGETS_SCHEMA = "perf_budgets/v1"
 PLANNER_GATE_SCHEMA = "planner_gate/v1"
 ONLINE_TUNE_SCHEMA = "online_tune/v1"
 SERVING_SCHEMA = "bench_serving/v2"
+MOE_GATE_SCHEMA = "moe_gate/v1"
+MOE_BENCH_SCHEMA = "moe_bench/v1"
+FLAT_ALLTOALL = "alltoall_flat"
 
 
 def _dig(doc, dotted):
@@ -369,6 +391,153 @@ def serving_gate(args):
     return 0 if ok else 1
 
 
+def moe_gate(args):
+    """Gate a ``bench_moe --sweep`` artifact: the hierarchical all-to-all
+    dispatch must strictly beat the flat lowering in enough cells, the
+    bf16-DCN wire must shrink cross-slice bytes at the largest payload,
+    and (with ``--moe-bench``) the FLOP-matched MoE run must match or
+    beat the dense baseline's final loss."""
+    from chainermn_tpu.planner import (
+        SWEEP_SCHEMA, autotune_from_rows, validate_sweep_rows)
+
+    with open(args.moe) as f:
+        sweep = json.load(f)
+    if sweep.get("schema") != SWEEP_SCHEMA:
+        print(f"perf_gate: unsupported sweep schema "
+              f"{sweep.get('schema')!r} (want {SWEEP_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    rows = sweep.get("rows", [])
+    validate_sweep_rows(rows)
+    problems = []
+
+    # per (topology, dtype, bytes) cell: mean us per plan, tuned = min,
+    # baseline = alltoall_flat in the same cell
+    cells = {}
+    for r in rows:
+        key = (r["topology"], r["dtype"], int(r["bytes"]))
+        cells.setdefault(key, {}).setdefault(r["plan"], []).append(
+            float(r["us"]))
+    comparison = []
+    wins = []
+    for (topo, dtype, nbytes), by_plan in sorted(cells.items()):
+        means = {p: sum(v) / len(v) for p, v in by_plan.items()}
+        tuned_plan = min(means, key=means.get)
+        flat_us = means.get(FLAT_ALLTOALL)
+        cell = {"topology": topo, "dtype": dtype, "bytes": nbytes,
+                "tuned_plan": tuned_plan,
+                "tuned_us": round(means[tuned_plan], 3),
+                "flat_us": round(flat_us, 3) if flat_us else None,
+                "speedup": (round(flat_us / means[tuned_plan], 3)
+                            if flat_us else None)}
+        win = (flat_us is not None and tuned_plan != FLAT_ALLTOALL
+               and means[tuned_plan] < flat_us)
+        cell["win"] = win
+        if win:
+            wins.append(cell)
+        comparison.append(cell)
+        mark = "WIN " if win else "    "
+        print(f"perf_gate {mark} {topo} {dtype} {nbytes:>9}: "
+              f"tuned={tuned_plan} ({cell['tuned_us']:.1f} us) vs "
+              f"{FLAT_ALLTOALL} ({cell['flat_us']} us) "
+              f"speedup={cell['speedup']}", file=sys.stderr)
+    need = int(args.require_alltoall_wins)
+    if len(wins) < need:
+        problems.append(f"hierarchical dispatch beats {FLAT_ALLTOALL} in "
+                        f"only {len(wins)} cell(s), gate requires {need}")
+
+    # DCN shrink at the largest swept payload (bench_moe writes the
+    # summary; recompute from rows if an older artifact lacks it)
+    largest = sweep.get("dcn_largest")
+    if not isinstance(largest, dict):
+        top = max(int(r["bytes"]) for r in rows)
+        flat = [r["dcn_bytes"] for r in rows
+                if int(r["bytes"]) == top and r["plan"] == FLAT_ALLTOALL]
+        bf16 = [r["dcn_bytes"] for r in rows
+                if int(r["bytes"]) == top
+                and r["plan"] == "alltoall_hier_bfloat16_dcn"]
+        largest = {"bytes": top,
+                   "flat_dcn_bytes": flat[0] if flat else None,
+                   "bf16_dcn_bytes": bf16[0] if bf16 else None,
+                   "bf16_shrink_x": (round(flat[0] / bf16[0], 3)
+                                     if flat and bf16 and bf16[0] else None)}
+    shrink = largest.get("bf16_shrink_x")
+    need_shrink = float(args.require_dcn_shrink)
+    if shrink is None:
+        problems.append("bf16-DCN shrink not derivable (sweep is missing "
+                        f"{FLAT_ALLTOALL} or alltoall_hier_bfloat16_dcn "
+                        "rows at the largest payload)")
+    elif float(shrink) < need_shrink:
+        problems.append(f"bf16-DCN dispatch shrinks DCN bytes only "
+                        f"x{float(shrink):.2f} at {largest.get('bytes')} B, "
+                        f"gate requires x{need_shrink}")
+    else:
+        print(f"perf_gate        dcn shrink x{float(shrink):.2f} at "
+              f"{largest.get('bytes')} B "
+              f"({largest.get('flat_dcn_bytes')} -> "
+              f"{largest.get('bf16_dcn_bytes')})", file=sys.stderr)
+
+    # matched-loss leg: FLOP-matched MoE must not lose to dense
+    matched = None
+    if args.moe_bench:
+        with open(args.moe_bench) as f:
+            bench = json.load(f)
+        if bench.get("schema") != MOE_BENCH_SCHEMA:
+            print(f"perf_gate: unsupported moe-bench schema "
+                  f"{bench.get('schema')!r} (want {MOE_BENCH_SCHEMA!r})",
+                  file=sys.stderr)
+            return 2
+        moe_loss = _dig(bench, "moe.final_loss")
+        dense_loss = _dig(bench, "dense.final_loss")
+        matched = {"artifact": os.path.basename(args.moe_bench),
+                   "moe_final_loss": moe_loss,
+                   "dense_final_loss": dense_loss,
+                   "ok": moe_loss <= dense_loss}
+        if not matched["ok"]:
+            problems.append(f"FLOP-matched MoE final loss {moe_loss:.4f} "
+                            f"above dense baseline {dense_loss:.4f}")
+        else:
+            print(f"perf_gate        matched loss: moe {moe_loss:.4f} <= "
+                  f"dense {dense_loss:.4f}", file=sys.stderr)
+
+    # the tuned table still comes from the shared autotuner so the
+    # moe_apply plan= seam loads it exactly like the 'auto' communicator
+    table, _ = autotune_from_rows(rows)
+    table.meta.update({"sweep": os.path.basename(args.moe),
+                       "collective": sweep.get("collective", "all-to-all"),
+                       "backend": sweep.get("backend"),
+                       "n_devices": sweep.get("n_devices")})
+    if args.table:
+        table.save(args.table)
+        print(f"perf_gate: all-to-all plan table ({len(table.entries)} "
+              f"cells) -> {args.table}", file=sys.stderr)
+    ok = not problems
+    artifact = {"schema": MOE_GATE_SCHEMA,
+                "sweep": os.path.basename(args.moe),
+                "backend": sweep.get("backend"),
+                "n_devices": sweep.get("n_devices"),
+                "topology": sweep.get("topology"),
+                "cells": comparison,
+                "tuned_wins": len(wins),
+                "required_wins": need,
+                "dcn_largest": largest,
+                "required_dcn_shrink_x": need_shrink,
+                "matched_loss": matched,
+                "problems": problems,
+                "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok, "tuned_wins": len(wins),
+                      "dcn_shrink_x": shrink,
+                      "cells": len(comparison)}), flush=True)
+    if not ok:
+        for p in problems:
+            print(f"perf_gate: FAIL — {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--budgets", default=None, metavar="BUDGETS.json",
@@ -416,20 +585,39 @@ def main():
                         help="serving mode: budgets file the floors are "
                              "read from (default: tools/perf_budgets.json "
                              "next to this script)")
+    parser.add_argument("--moe", default=None, metavar="SWEEP.json",
+                        help="MoE gate mode: bench_moe --sweep artifact "
+                             "(all-to-all plan zoo) to autotune and gate")
+    parser.add_argument("--moe-bench", default=None,
+                        metavar="MOE_BENCH.json",
+                        help="MoE mode: bench_moe --out matched-loss "
+                             f"artifact (schema {MOE_BENCH_SCHEMA}); the "
+                             "FLOP-matched MoE final loss must be at or "
+                             "below the dense baseline")
+    parser.add_argument("--require-alltoall-wins", type=int, default=2,
+                        metavar="N",
+                        help="MoE mode: cells where a non-flat plan must "
+                             "strictly beat alltoall_flat (default 2)")
+    parser.add_argument("--require-dcn-shrink", type=float, default=1.8,
+                        metavar="X",
+                        help="MoE mode: minimum bf16-DCN byte shrink at "
+                             "the largest swept payload (default 1.8)")
     parser.add_argument("--out", default=None, metavar="OUT.json",
                         help="write the gate report/artifact JSON here")
     args = parser.parse_args()
     modes = [bool(args.budgets), bool(args.planner),
-             bool(args.online_tune), bool(args.serving)]
+             bool(args.online_tune), bool(args.serving), bool(args.moe)]
     if sum(modes) != 1:
         parser.error("pass exactly one of --budgets, --planner, "
-                     "--online-tune, or --serving")
+                     "--online-tune, --serving, or --moe")
     if args.planner:
         return planner_gate(args)
     if args.online_tune:
         return online_tune_gate(args)
     if args.serving:
         return serving_gate(args)
+    if args.moe:
+        return moe_gate(args)
     return check_budgets(args)
 
 
