@@ -421,17 +421,23 @@ def _traced(args):
     exact :func:`_time_spmd` discipline — once with observability off
     (the zero-callback program) and once with a flight recorder
     installed, which makes ``execute_plan`` re-trace the plan with its
-    ``plan_stage_begin``/``_end`` debug callbacks in.  Each arm runs
-    ``--repeats`` times interleaved and reports its MIN (standard
-    microbenchmark noise floor).  The written artifact
-    (``tracing_overhead/v1``) carries ``tracing_overhead_pct``, the
-    number ``tools/perf_budgets.json`` holds under 3%.
+    ``plan_stage_begin``/``_end`` debug callbacks in.  The traced arm
+    also runs the streaming fleet-telemetry aggregator
+    (:class:`~chainermn_tpu.observability.streaming.TelemetryAggregator`)
+    once per repeat, amortizing one ``collect()`` over ``--iters``
+    iterations into the on-arm time — the cost of shipping a telemetry
+    window every ``iters`` steps, which is how ``MetricsReport``
+    triggers it.  Each arm runs ``--repeats`` times interleaved and
+    reports its MIN (standard microbenchmark noise floor).  The written
+    artifact (``tracing_overhead/v1``) carries ``tracing_overhead_pct``,
+    the number ``tools/perf_budgets.json`` holds under 3%.
     """
     import jax
     import jax.numpy as jnp
 
     import chainermn_tpu
     from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.observability.streaming import TelemetryAggregator
 
     flavor = args.communicators.split(",")[0]
     kwargs = {}
@@ -459,9 +465,10 @@ def _traced(args):
 
     had_recorder = _flight.get_flight_recorder() is not None
     times = {"off": [], "on": []}
+    collects = []
     events_recorded = 0
     try:
-        for _ in range(max(int(args.repeats), 1)):
+        for i in range(max(int(args.repeats), 1)):
             if not had_recorder:
                 _flight.reset_flight_recorder()
             times["off"].append(run_arm())
@@ -469,6 +476,12 @@ def _traced(args):
             before = len(fr.snapshot())
             times["on"].append(run_arm())
             events_recorded = len(fr.snapshot()) - before
+            # the streaming window ride-along: one telemetry collect per
+            # emit interval (= iters steps), amortized into the on-arm
+            agg = TelemetryAggregator(comm)
+            c0 = time.perf_counter()
+            agg.collect(i)
+            collects.append(time.perf_counter() - c0)
     finally:
         if not had_recorder:
             _flight.reset_flight_recorder()
@@ -476,7 +489,9 @@ def _traced(args):
         print("--traced: the traced arm recorded no plan_stage events — "
               "overhead A/B is meaningless", file=sys.stderr)
         return 1
-    t_off, t_on = min(times["off"]), min(times["on"])
+    collect_s = min(collects) if collects else 0.0
+    t_off = min(times["off"])
+    t_on = min(times["on"]) + collect_s / max(int(args.iters), 1)
     pct = (t_on - t_off) / t_off * 100.0
     doc = {"schema": "tracing_overhead/v1",
            "backend": jax.default_backend(),
@@ -487,6 +502,7 @@ def _traced(args):
            "repeats": args.repeats,
            "time_ms_off": round(t_off * 1e3, 4),
            "time_ms_on": round(t_on * 1e3, 4),
+           "streaming_collect_ms": round(collect_s * 1e3, 4),
            "events_per_traced_run": events_recorded,
            "tracing_overhead_pct": round(pct, 3),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}

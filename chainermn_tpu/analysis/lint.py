@@ -100,7 +100,7 @@ class LintContext:
                  inter_size=None, plan=None, loss=None, loss_args=None,
                  donate_argnums=(), fsdp_meta=None, fsdp_state=None,
                  variants=None, census=False, hlo=True,
-                 max_const_bytes=DEFAULT_MAX_BYTES):
+                 max_const_bytes=DEFAULT_MAX_BYTES, flight_events=None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs or {}
@@ -121,6 +121,7 @@ class LintContext:
         self.fsdp_meta = fsdp_meta
         self.fsdp_state = fsdp_state
         self._variants_spec = variants
+        self.flight_events = flight_events
         self.census = census
         self.hlo = hlo
         self.max_const_bytes = max_const_bytes
@@ -264,6 +265,27 @@ class LintContext:
             return out
         return self._memo("variants", build)
 
+    @property
+    def flight_spans(self) -> Optional[Dict[int, list]]:
+        """Per-rank paired spans rebuilt from flight-recorder events —
+        the ``overlapping-collectives`` input.  ``flight_events`` is a
+        flat event list (linted as rank 0) or ``{rank: events}``; a
+        flight dump's ``events`` list feeds it directly."""
+        def build():
+            ev = self.flight_events
+            if not ev:
+                self.unavailable["flight_spans"] = \
+                    "no flight_events given (pass flight_events=)"
+                return None
+            from chainermn_tpu.observability.spans import pair_events
+            if isinstance(ev, dict):
+                by_rank = {int(r): list(e) for r, e in ev.items()}
+            else:
+                by_rank = {0: list(ev)}
+            return {r: pair_events(e, rank=r)
+                    for r, e in sorted(by_rank.items())}
+        return self._memo("flight_spans", build)
+
 
 def allreduce_hlo(comm, nelems: int = 1024, dtype=jnp.float32,
                   plan=None) -> str:
@@ -338,6 +360,7 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
               fsdp_meta=None, fsdp_state=None, variants=None,
               census=False, hlo: bool = True,
               max_const_bytes: int = DEFAULT_MAX_BYTES,
+              flight_events=None,
               rules: Optional[Sequence[str]] = None,
               raise_on_error: bool = True, name: str = "",
               **kwargs) -> LintReport:
@@ -355,7 +378,8 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
                       donate_argnums=donate_argnums, fsdp_meta=fsdp_meta,
                       fsdp_state=fsdp_state, variants=variants,
                       census=census, hlo=hlo,
-                      max_const_bytes=max_const_bytes)
+                      max_const_bytes=max_const_bytes,
+                      flight_events=flight_events)
     report = LintReport(target=ctx.name)
     selected = [get_rule(r) for r in rules] if rules else all_rules()
     for rule in selected:

@@ -648,5 +648,67 @@ def _async_pair(ctx) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# overlapping-collectives — independently-tuned plans contending for a link
+# ---------------------------------------------------------------------------
+
+@rule("overlapping-collectives", "warning",
+      "concurrent same-link-class collectives with independently-tuned plans",
+      requires=("flight_spans",))
+def _overlapping_collectives(ctx) -> List[Finding]:
+    """Flag spans that occupy the SAME link class at the SAME time but
+    belong to DIFFERENT tuning identities (plan names / subsystems).
+
+    Each independently-tuned plan prices the link at full bandwidth, so
+    when two of them actually run concurrently both deliver below their
+    modeled GB/s — the contention blind spot ROADMAP item 4 names.
+    Spans sharing one identity are one co-tuned decision (a striped
+    plan's concurrent groups split the link on purpose) and are never
+    flagged.  Severity is ``warning``: contention is a throughput bug,
+    not a wedge.  Runtime evidence, not a compile-time proof — feed it
+    the flight events of a representative window (``flight_events=``,
+    or a flight dump's ``events`` via ``cmn_lint --events``).
+    """
+    from chainermn_tpu.observability.contention import (
+        leaf_comm_spans, plan_identity, span_link)
+    cells: Dict[tuple, dict] = {}
+    for rank, spans in sorted(ctx.flight_spans.items()):
+        per_link: Dict[str, list] = {}
+        for sp in leaf_comm_spans(spans):
+            link, ident = span_link(sp), plan_identity(sp)
+            if link is not None and ident is not None:
+                per_link.setdefault(link, []).append((sp.t0, sp.t1, ident))
+        for link, rows in per_link.items():
+            rows.sort()
+            active: List[tuple] = []  # sweep: spans still open at t0
+            for t0, t1, ident in rows:
+                active = [r for r in active if r[1] > t0]
+                for _a0, a1, aident in active:
+                    if aident == ident:
+                        continue
+                    ov = min(a1, t1) - t0
+                    if ov <= 0.0:
+                        continue
+                    a, b = sorted((aident, ident))
+                    cell = cells.setdefault(
+                        (link, a, b), {"s": 0.0, "n": 0, "ranks": set()})
+                    cell["s"] += ov
+                    cell["n"] += 1
+                    cell["ranks"].add(rank)
+                active.append((t0, t1, ident))
+    out: List[Finding] = []
+    for (link, a, b), cell in sorted(cells.items()):
+        out.append(_finding(
+            f"{a!r} and {b!r} overlap on the {link} link class for "
+            f"{cell['s'] * 1e3:.3f} ms across {cell['n']} span pair(s) "
+            f"but are tuned independently — each plan prices the link "
+            f"at full bandwidth, so both run below their modeled GB/s "
+            f"under contention.  Inspect with obs_report --contention; "
+            f"co-tune them into one plan or serialize the issue order.",
+            link=link, identities=[a, b], contended_s=cell["s"],
+            n_pairs=cell["n"], ranks=sorted(cell["ranks"])))
+    return out
+
+
 __all__ = ["CPU_WIRE_PROMOTIONS", "Finding", "NP_TO_HLO_DTYPE", "Rule",
            "SEVERITIES", "all_rules", "expected_kinds", "get_rule", "rule"]

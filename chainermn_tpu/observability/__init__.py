@@ -28,6 +28,7 @@ from chainermn_tpu.observability.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    StreamingHistogram,
     disable,
     enable,
     enabled,
@@ -76,6 +77,22 @@ from chainermn_tpu.observability.flight_recorder import (
     install_flight_recorder,
     reset_flight_recorder,
 )
+from chainermn_tpu.observability.contention import (
+    attribution_consistency,
+    contention_report,
+    feed_link_observations,
+    leaf_comm_spans,
+    link_rates,
+    occupancy_from_events,
+    occupancy_timelines,
+    overlap_matrix,
+    plan_identity,
+    span_link,
+    span_owner,
+)
+from chainermn_tpu.observability.streaming import (
+    TelemetryAggregator,
+)
 from chainermn_tpu.observability.watchdog import (
     Watchdog,
     WatchdogConfig,
@@ -96,29 +113,42 @@ __all__ = [
     "Span",
     "StepTelemetry",
     "StragglerDetector",
+    "StreamingHistogram",
+    "TelemetryAggregator",
     "Watchdog",
     "WatchdogConfig",
     "append_jsonl",
     "atomic_write_json",
     "attribute_step",
+    "attribution_consistency",
     "attribution_report",
     "build_step_trees",
     "clock_handshake",
+    "contention_report",
     "critical_path",
     "disable",
     "enable",
     "enabled",
+    "feed_link_observations",
     "get_flight_recorder",
     "get_plan_obs",
     "get_registry",
     "identify_desync",
     "install_flight_recorder",
     "instrument_communicator",
+    "leaf_comm_spans",
+    "link_rates",
     "merge_ranks",
+    "occupancy_from_events",
+    "occupancy_timelines",
     "offset_from_samples",
+    "overlap_matrix",
+    "plan_identity",
     "prometheus_text",
     "read_jsonl",
     "reset_flight_recorder",
+    "span_link",
+    "span_owner",
     "span_summary",
     "start_watchdog",
     "straggler_report",

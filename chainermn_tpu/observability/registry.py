@@ -17,6 +17,7 @@ Design constraints (ISSUE 1 tentpole):
 
 from __future__ import annotations
 
+import bisect
 import math
 import os
 import threading
@@ -188,6 +189,160 @@ class Histogram(_Metric):
         return out
 
 
+class StreamingHistogram(_Metric):
+    """Mergeable latency distribution over FIXED log-spaced buckets —
+    the fleet-telemetry metric kind (ISSUE 16).
+
+    A :class:`Histogram` keeps a reservoir of raw values, which cannot
+    be combined across ranks; this kind keeps per-bucket counts on a
+    log grid fixed at construction, so rank 0 merges peers' shipped
+    states with an elementwise add (:meth:`merge`) and percentiles of
+    the FLEET distribution stay exact to bucket resolution.  Exported
+    quantiles are p50/p95/p99 (the serving SLO gauges); the Prometheus
+    sink renders the buckets as a native cumulative histogram.
+
+    Bucket ``i`` counts observations in ``(bounds[i-1], bounds[i]]``
+    (bucket 0: ``(-inf, bounds[0]]``); one overflow bucket past
+    ``hi``.  ``buckets_per_decade`` sets resolution (~29% relative
+    error at the default 9/decade).
+    """
+
+    kind = "streaming_histogram"
+
+    _QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "", lo: float = 1e-5,
+                 hi: float = 1e3, buckets_per_decade: int = 9):
+        super().__init__(name, help)
+        if not (lo > 0.0 and hi > lo):
+            raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.buckets_per_decade = int(buckets_per_decade)
+        decades = math.log10(self.hi) - math.log10(self.lo)
+        n = int(math.ceil(decades * self.buckets_per_decade)) + 1
+        self.bounds: Tuple[float, ...] = tuple(
+            self.lo * 10.0 ** (i / self.buckets_per_decade)
+            for i in range(n))
+
+    def _new_series(self) -> dict:
+        return {"counts": [0] * (len(self.bounds) + 1),
+                "sum": 0.0, "count": 0}
+
+    def _bucket_index(self, value: float) -> int:
+        return bisect.bisect_left(self.bounds, value)
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        value = float(value)
+        idx = self._bucket_index(value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._new_series()
+                self._series[key] = s
+            s["counts"][idx] += 1
+            s["sum"] += value
+            s["count"] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return int(s["count"]) if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return float(s["sum"]) if s else 0.0
+
+    def state(self, **labels) -> dict:
+        """Shippable series state (the compact per-rank summary the
+        telemetry aggregator sends to rank 0): bucket counts + sum +
+        count, stamped with the grid config so :meth:`merge` can refuse
+        a mismatched peer."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            counts = list(s["counts"]) if s else \
+                [0] * (len(self.bounds) + 1)
+            return {"counts": counts,
+                    "sum": float(s["sum"]) if s else 0.0,
+                    "count": int(s["count"]) if s else 0,
+                    "lo": self.lo, "hi": self.hi,
+                    "buckets_per_decade": self.buckets_per_decade}
+
+    def merge(self, state: dict, **labels) -> None:
+        """Elementwise-add a peer's :meth:`state` into this series —
+        the rank-0 fleet merge.  Raises on a bucket-grid mismatch."""
+        counts = list(state.get("counts") or [])
+        if len(counts) != len(self.bounds) + 1:
+            raise ValueError(
+                f"streaming histogram {self.name!r}: peer state has "
+                f"{len(counts)} buckets, this grid has "
+                f"{len(self.bounds) + 1}")
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._new_series()
+                self._series[key] = s
+            for i, c in enumerate(counts):
+                s["counts"][i] += int(c)
+            s["sum"] += float(state.get("sum", 0.0))
+            s["count"] += int(state.get("count", 0))
+
+    def _quantile_from_counts(self, counts, q: float) -> Optional[float]:
+        total = sum(counts)
+        if total <= 0:
+            return None
+        target = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c <= 0:
+                continue
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = self.bounds[i - 1] if i >= 1 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) \
+                    else self.bounds[-1]
+                frac = (target - prev) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.bounds[-1]
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Bucket-interpolated quantile (``None`` with no data)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            counts = list(s["counts"]) if s else []
+        return self._quantile_from_counts(counts, q)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            items = [(dict(k), {"counts": list(s["counts"]),
+                                "sum": float(s["sum"]),
+                                "count": int(s["count"])})
+                     for k, s in self._series.items()]
+        out = []
+        for labels, s in items:
+            cum, cum_counts = 0, []
+            for c in s["counts"]:
+                cum += c
+                cum_counts.append(cum)
+            out.append({
+                "name": self.name, "type": "streaming_histogram",
+                "labels": labels,
+                "count": s["count"], "sum": s["sum"],
+                "quantiles": {
+                    str(p): self._quantile_from_counts(s["counts"], p)
+                    for p in self._QUANTILES},
+                "le": list(self.bounds),
+                "bucket_counts": cum_counts,  # cumulative, +Inf last
+            })
+        return out
+
+
 class _Timer:
     """Context manager recording monotonic elapsed seconds into a histogram."""
 
@@ -239,6 +394,14 @@ class MetricsRegistry:
                   window_size: int = 1024) -> Histogram:
         return self._get_or_create(Histogram, name, help,
                                    window_size=window_size)
+
+    def streaming_histogram(self, name: str, help: str = "",
+                            lo: float = 1e-5, hi: float = 1e3,
+                            buckets_per_decade: int = 9
+                            ) -> StreamingHistogram:
+        return self._get_or_create(StreamingHistogram, name, help,
+                                   lo=lo, hi=hi,
+                                   buckets_per_decade=buckets_per_decade)
 
     def timer(self, name: str, help: str = "", **labels) -> _Timer:
         """``with registry.timer("step_seconds", phase="dispatch"): ...``"""

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
 import time
 from typing import Iterable, List, Optional
@@ -84,7 +85,31 @@ def write_snapshot_jsonl(path: str, snapshot: Iterable[dict],
 
 # ---- Prometheus text exposition (format 0.0.4) -----------------------------
 
+#: metric names must match [a-zA-Z_:][a-zA-Z0-9_:]*, label names
+#: [a-zA-Z_][a-zA-Z0-9_]* — anything else (a "plan:inter" seam leaking
+#: into a metric name, a "wire-dtype" label key) would emit lines every
+#: scraper rejects, taking the WHOLE exposition file down with it.
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize_metric_name(name: str) -> str:
+    out = _NAME_BAD.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _sanitize_label_name(name: str) -> str:
+    out = _LABEL_BAD.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
 def _escape_label(v: str) -> str:
+    # order matters: escape the escape character first, or the
+    # backslashes introduced for newline/quote get doubled
     return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
 
 
@@ -94,8 +119,9 @@ def _labels_text(labels: dict, extra: Optional[dict] = None) -> str:
         items.update(extra)
     if not items:
         return ""
-    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
-                     for k, v in sorted(items.items()))
+    inner = ",".join(
+        f'{_sanitize_label_name(k)}="{_escape_label(str(v))}"'
+        for k, v in sorted(items.items()))
     return "{" + inner + "}"
 
 
@@ -121,7 +147,8 @@ def prometheus_text(snapshot: Iterable[dict],
     for name in sorted(by_name):
         recs = by_name[name]
         kind = recs[0].get("type", "gauge")
-        full = f"{namespace}_{name}" if namespace else name
+        full = _sanitize_metric_name(
+            f"{namespace}_{name}" if namespace else name)
         if kind == "counter":
             lines.append(f"# TYPE {full}_total counter")
             for r in recs:
@@ -140,6 +167,35 @@ def prometheus_text(snapshot: Iterable[dict],
                 lines.append(
                     f"{full}_count{_labels_text(r['labels'])} "
                     f"{_num(r['count'])}")
+        elif kind == "streaming_histogram":
+            # fixed log-spaced buckets -> a native Prometheus histogram
+            # (cumulative le series), plus explicit p50/p95/p99 gauges
+            # (the SLO percentile export obs_report renders)
+            lines.append(f"# TYPE {full} histogram")
+            lines.append(f"# TYPE {full}_quantile gauge")
+            for r in recs:
+                le = r.get("le") or []
+                cum = r.get("bucket_counts") or []
+                for bound, c in zip(le, cum):
+                    lines.append(
+                        f"{full}_bucket"
+                        f"{_labels_text(r['labels'], {'le': _num(bound)})}"
+                        f" {_num(c)}")
+                total = cum[-1] if cum else r.get("count", 0)
+                lines.append(
+                    f"{full}_bucket"
+                    f"{_labels_text(r['labels'], {'le': '+Inf'})} "
+                    f"{_num(total)}")
+                lines.append(
+                    f"{full}_sum{_labels_text(r['labels'])} {_num(r['sum'])}")
+                lines.append(
+                    f"{full}_count{_labels_text(r['labels'])} "
+                    f"{_num(r['count'])}")
+                for q, v in sorted((r.get("quantiles") or {}).items()):
+                    lines.append(
+                        f"{full}_quantile"
+                        f"{_labels_text(r['labels'], {'quantile': q})}"
+                        f" {_num(v)}")
         else:
             lines.append(f"# TYPE {full} gauge")
             for r in recs:

@@ -63,6 +63,13 @@ def _structural(op):
     return apply
 
 
+#: Reserved tag band for the streaming fleet-telemetry aggregator
+#: (observability/streaming.py).  Kept far from the default tag=0 object
+#: plane and the barrier band (900) so per-step telemetry gathers can
+#: never cross wires with user sends in flight on the same edge.
+TELEMETRY_TAG = 770
+
+
 def _resolve_op(op):
     if callable(op):
         return op  # custom binary reducible — object-level
@@ -200,6 +207,13 @@ class ControlPlane(abc.ABC):
 
     def barrier(self, tag: int = 900) -> None:
         self.allgather_obj(None, tag=tag)
+
+    def gather_telemetry(self, summary: Any, root: int = 0) -> Optional[List[Any]]:
+        """Ship one compact per-step telemetry summary to ``root`` on the
+        reserved :data:`TELEMETRY_TAG` band.  Collective: every rank must
+        call it on the same step (the aggregator's emit trigger guarantees
+        this).  Returns the rank-ordered list on root, None elsewhere."""
+        return self.gather_obj(summary, root=root, tag=TELEMETRY_TAG)
 
 
 class SingleProcessControlPlane(ControlPlane):

@@ -273,8 +273,19 @@ class InferenceEngine:
                                    "waiting requests (rank 0)"),
                 "pages": reg.gauge("serving_free_pages",
                                    "free KV pages"),
-                "step_s": reg.histogram("serving_step_seconds",
-                                        "wall time per engine step"),
+                # latency SLO family: streaming histograms (mergeable
+                # fixed log-grid buckets) so the fleet-telemetry
+                # aggregator can fold per-rank distributions into
+                # exact fleet p50/p95/p99
+                "step_s": reg.streaming_histogram(
+                    "serving_step_seconds",
+                    "wall time per engine step"),
+                "ttft": reg.streaming_histogram(
+                    "serving_ttft_seconds",
+                    "arrival to first emitted token"),
+                "tok_s": reg.streaming_histogram(
+                    "serving_token_seconds",
+                    "inter-token gap per emitted token"),
                 # speculative-decoding family
                 "spec_rows": reg.counter(
                     "serving_spec_rows",
@@ -646,7 +657,15 @@ class InferenceEngine:
                 emitted = sched.note_sampled(n_new, sampled)
             now = time.perf_counter()
             for rid, _tok, _n in emitted:
-                self._token_times.setdefault(rid, []).append(now)
+                times = self._token_times.setdefault(rid, [])
+                if self._m is not None:
+                    if times:
+                        self._m["tok_s"].observe(now - times[-1])
+                    else:
+                        arrival = self._arrivals.get(rid)
+                        if arrival is not None:
+                            self._m["ttft"].observe(now - arrival)
+                times.append(now)
 
         if self._m is not None:
             self._m["steps"].inc()

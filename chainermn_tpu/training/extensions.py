@@ -118,7 +118,8 @@ class MetricsReport:
                  online_tune: bool = False,
                  online_tune_threshold: float = 1.05,
                  online_tune_link_gbps: Optional[dict] = None,
-                 fsdp_prefetch: Optional[tuple] = None):
+                 fsdp_prefetch: Optional[tuple] = None,
+                 stream_telemetry: bool = False):
         if straggler_every < 1:
             raise ValueError(f"straggler_every must be >= 1, got "
                              f"{straggler_every}")
@@ -156,6 +157,15 @@ class MetricsReport:
         self._online_tune_link_gbps = online_tune_link_gbps
         self._fsdp_prefetch = fsdp_prefetch
         self._tuner = None
+        # stream_telemetry=True ships each rank's compact per-window
+        # summary (occupancy, dropped events, step times, serving
+        # latency histograms) to rank 0 over the control plane at every
+        # emit and appends the folded fleet_telemetry document to the
+        # JSONL (obs_report --contention / --live render it).  Off by
+        # default: zero control-plane traffic when unset, and the whole
+        # aggregator only exists when observability is enabled.
+        self._want_stream = stream_telemetry
+        self._stream = None
         self._active = False
 
     def initialize(self, trainer):
@@ -195,6 +205,10 @@ class MetricsReport:
                 comm=comm, registry=reg, flight=self._fr,
                 threshold=self._online_tune_threshold,
                 fallback_gbps=self._online_tune_link_gbps)
+        if self._want_stream:
+            from chainermn_tpu.observability.streaming import \
+                TelemetryAggregator
+            self._stream = TelemetryAggregator(comm)
         want_wd = self._want_watchdog
         if want_wd is None:
             want_wd = os.environ.get("CHAINERMN_TPU_WATCHDOG", "") \
@@ -296,11 +310,18 @@ class MetricsReport:
                 step_fn = getattr(trainer.updater, "step_fn", None)
                 if hasattr(step_fn, "clear_cache"):
                     step_fn.clear_cache()
+        fleet = None
+        if self._stream is not None:
+            # COLLECTIVE (control-plane gather to rank 0): every rank
+            # ships its telemetry window at this trigger.
+            fleet = self._stream.collect(trainer.updater.iteration)
         if not self._is_writer:
             return
         append_jsonl(self._path, record)
         write_snapshot_jsonl(self._path, self._reg.snapshot(),
                              rank=self._comm.rank)
+        if fleet is not None:
+            append_jsonl(self._path, dict(fleet, ts=time.time()))
         if straggler is not None:
             straggler = dict(straggler,
                              iteration=trainer.updater.iteration)

@@ -531,6 +531,62 @@ def test_rule_async_pair():
         SimpleNamespace(hlo_schedule=clean, name="synthetic"))
 
 
+def _flight(kind_begin, kind_end, t0, t1, **f):
+    return [{"kind": kind_begin, "ts": t0, "seq": 0, **f},
+            {"kind": kind_end, "ts": t1, "seq": 1, **f}]
+
+
+def test_rule_overlapping_collectives_fires_on_contended_link():
+    """An FSDP gather and a MoE all-to-all hop concurrent on the ici
+    link are independently tuned -> one warning finding naming both
+    identities and the contended seconds.  Warning severity: the report
+    stays ok (contention is a throughput bug, not a wedge)."""
+    events = (
+        _flight("fsdp_gather_begin", "fsdp_gather_end", 10.010, 10.030,
+                bucket=0, link="ici", nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 10.020, 10.040,
+                  plan="alltoall_hier", op="all_to_all", stage=0,
+                  scope="intra", link="ici", nbytes=1 << 16))
+    for i, e in enumerate(events):
+        e["seq"] = i
+    rep = lint_step(None, flight_events={0: events},
+                    rules=["overlapping-collectives"], hlo=False,
+                    raise_on_error=False, name="synthetic")
+    assert rep.ok  # warning, not error
+    assert [f.rule for f in rep.findings] == ["overlapping-collectives"]
+    f = rep.findings[0]
+    assert f.severity == "warning"
+    assert f.details["link"] == "ici"
+    assert f.details["identities"] == ["fsdp", "plan:alltoall_hier"]
+    assert f.details["contended_s"] == pytest.approx(0.010)
+    assert f.details["ranks"] == [0]
+
+
+def test_rule_overlapping_collectives_ignores_cotuned_stripes():
+    """Concurrent groups of ONE striped plan share a tuning identity
+    (their link split is a single co-tuned decision) and never fire."""
+    stripe = dict(plan="striped_bf16", op="all-reduce", stage=0,
+                  scope="intra", link="ici", nbytes=1 << 18)
+    events = (
+        _flight("plan_stage_begin", "plan_stage_end", 5.000, 5.020,
+                group=0, **stripe)
+        + _flight("plan_stage_begin", "plan_stage_end", 5.005, 5.025,
+                  group=1, **stripe))
+    for i, e in enumerate(events):
+        e["seq"] = i
+    rep = lint_step(None, flight_events=events,
+                    rules=["overlapping-collectives"], hlo=False,
+                    raise_on_error=False)
+    assert rep.ok and rep.findings == []
+
+
+def test_rule_overlapping_collectives_skips_without_events(devices):
+    rep = lint_step(lambda x: x * 2, jnp.ones((4,)), hlo=False,
+                    raise_on_error=False)
+    assert "overlapping-collectives" in rep.skipped
+    assert "flight_events" in rep.skipped["overlapping-collectives"]
+
+
 # ---------------------------------------------------------------------------
 # lint_step API / fixture behavior
 # ---------------------------------------------------------------------------

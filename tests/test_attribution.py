@@ -158,6 +158,39 @@ class TestClockMath:
         hs = clock_handshake(None)
         assert hs == {"rank": 0, "offset_s": 0.0, "rtt_s": 0.0, "rounds": 0}
 
+    def test_negative_offset_recovered(self):
+        # a peer clock RUNNING AHEAD yields a negative offset; the math
+        # must not assume a sign
+        true_off = -0.4
+        samples = [(50.0, 50.0 + 0.001 + true_off, 50.002)]
+        off, rtt = offset_from_samples(samples)
+        assert off == pytest.approx(true_off, abs=1e-9)
+
+    def test_merge_ranks_applies_negative_offset(self):
+        base = merge_ranks({0: _stream(), 1: _stream(rank=1)})
+        shifted = merge_ranks({0: _stream(), 1: _stream(rank=1)},
+                              offsets={1: -0.35})
+        for a, b in zip(base[0][0].walk(), shifted[0][0].walk()):
+            assert b.t0 == pytest.approx(a.t0)  # rank 0 untouched
+        for a, b in zip(base[1][0].walk(), shifted[1][0].walk()):
+            assert b.t0 == pytest.approx(a.t0 - 0.35)
+            assert b.t1 == pytest.approx(a.t1 - 0.35)
+            assert b.t1 >= b.t0
+
+    def test_offset_exceeding_span_durations_keeps_geometry(self):
+        # a 5s skew dwarfs every ms-scale span: the shift must preserve
+        # nesting and the exact bucket decomposition, not just ordering
+        trees = merge_ranks({0: _stream(), 1: _stream(rank=1)},
+                            offsets={1: 5.0})
+        step = trees[1][0]
+        kinds = [sp.kind for sp in step.walk()]
+        ref = [sp.kind for sp in merge_ranks(
+            {1: _stream(rank=1)})[1][0].walk()]
+        assert kinds == ref  # same tree shape after the big shift
+        a = attribute_step(step)
+        assert a["sum_frac"] == pytest.approx(1.0)
+        assert a["buckets"]["dcn_comm"] == pytest.approx(0.006)
+
 
 # ---- cross-rank merge + critical path --------------------------------------
 

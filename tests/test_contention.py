@@ -1,0 +1,329 @@
+"""Link-contention observatory tests (ISSUE 16 tentpole, parts a+b).
+
+Pins the observatory's guarantees: comm spans classify into (link
+class, owning subsystem, tuning identity) exactly as the attribution
+buckets cut them; the leaf guard drops a trace-time collective parent
+so plan-stage children are not double-counted; occupancy timelines and
+the overlap matrix report the hand-computable contended seconds of a
+synthetic FSDP x MoE step; link rates satisfy ``contended <= busy <=
+span_s`` with the effective/modeled derate; effective rates feed an
+online-tuner ``LinkObservations`` stub as (bytes, union-busy) samples;
+``contention_report`` reconciles occupancy with the attribution buckets
+per (rank, step, link) on a clock-offset two-rank merge; and the
+streaming :class:`TelemetryAggregator` folds a single-process fleet
+document (occupancy, live overlap, SLO quantiles, fleet gauges) with a
+once-only window cursor.
+"""
+
+import time
+
+import pytest
+
+from chainermn_tpu import observability as obs
+from chainermn_tpu.observability.attribution import _total
+from chainermn_tpu.observability.contention import (
+    attribution_consistency,
+    contention_report,
+    feed_link_observations,
+    leaf_comm_spans,
+    link_rates,
+    occupancy_from_events,
+    occupancy_timelines,
+    overlap_matrix,
+    plan_identity,
+    span_link,
+    span_owner,
+)
+from chainermn_tpu.observability.flight_recorder import (
+    get_flight_recorder, reset_flight_recorder)
+from chainermn_tpu.observability.spans import Span
+from chainermn_tpu.observability.streaming import (
+    SCHEMA, TelemetryAggregator)
+
+
+def _span(kind, t0, t1, rank=0, **meta):
+    return Span(name=kind, kind=kind, rank=rank, t0=t0, t1=t1, meta=meta)
+
+
+def _events(base=100.0):
+    """One rank's synthetic one-step stream: a 20ms FSDP gather on ici
+    overlapped 10ms by a MoE all-to-all intra hop, then a DCN inter hop
+    and a control-plane object broadcast, inside a 100ms step."""
+    evs, seq = [], 0
+
+    def ev(kind, ts, **f):
+        nonlocal seq
+        evs.append({"kind": kind, "ts": ts, "seq": seq, **f})
+        seq += 1
+
+    fs = dict(bucket=0, link="ici", nbytes=3_000_000)
+    ev("fsdp_gather_begin", base + 0.010, **fs)
+    ev("fsdp_gather_end", base + 0.030, **fs)
+    moe = dict(plan="alltoall_hier", op="all_to_all", nbytes=1_000_000)
+    ev("plan_stage_begin", base + 0.020, stage=0, scope="intra",
+       link="ici", **moe)
+    ev("plan_stage_end", base + 0.040, stage=0, scope="intra",
+       link="ici", **moe)
+    ev("plan_stage_begin", base + 0.050, stage=1, scope="inter",
+       link="dcn", **moe)
+    ev("plan_stage_end", base + 0.060, stage=1, scope="inter",
+       link="dcn", **moe)
+    ev("object_begin", base + 0.065, op="plan_table", op_seq=1)
+    ev("object_end", base + 0.070, op="plan_table", op_seq=1)
+    ev("step", base + 0.100, dur_s=0.100, iteration=1)
+    return evs
+
+
+def _tree():
+    """The hand-built step tree matching :func:`_events` rank 0."""
+    step = _span("step", 100.0, 100.1, iteration=1)
+    step.children = [
+        _span("fsdp", 100.010, 100.030, link="ici", nbytes=3_000_000),
+        _span("plan_stage", 100.020, 100.040, plan="alltoall_hier",
+              scope="intra", link="ici", nbytes=1_000_000),
+        _span("plan_stage", 100.050, 100.060, plan="alltoall_hier",
+              scope="inter", link="dcn", nbytes=1_000_000),
+        _span("object", 100.065, 100.070, op="plan_table"),
+    ]
+    return step
+
+
+# ---- classification ---------------------------------------------------------
+
+class TestClassifiers:
+    def test_moe_plan_stage(self):
+        sp = _span("plan_stage", 0, 1, plan="alltoall_hier_bf16",
+                   scope="intra", link="ici")
+        assert span_link(sp) == "ici"
+        assert span_owner(sp) == "moe"
+        assert plan_identity(sp) == "plan:alltoall_hier_bf16"
+
+    def test_serving_plan_stage(self):
+        sp = _span("plan_stage", 0, 1, plan="serving_multicast",
+                   scope="inter", link="dcn")
+        assert span_link(sp) == "dcn"
+        assert span_owner(sp) == "serving"
+
+    def test_generic_plan_keyed_by_scope(self):
+        sp = _span("plan_stage", 0, 1, plan="hier", scope="inter",
+                   link="dcn")
+        assert span_owner(sp) == "plan:inter"
+        assert plan_identity(sp) == "plan:hier"
+
+    def test_fsdp_object_collective(self):
+        fs = _span("fsdp", 0, 1, link="ici")
+        assert (span_link(fs), span_owner(fs)) == ("ici", "fsdp")
+        assert plan_identity(fs) == "fsdp"
+        ob = _span("object", 0, 1, op="bcast")
+        assert (span_link(ob), span_owner(ob)) == ("dcn", "control")
+        assert plan_identity(ob) == "object:bcast"
+        co = _span("collective", 0, 1, op="allreduce_grad")
+        assert (span_link(co), span_owner(co)) == ("ici", "collective")
+        assert plan_identity(co) == "collective:allreduce_grad"
+
+    def test_non_comm_spans_are_none(self):
+        ph = _span("phase", 0, 1, phase="data_load")
+        assert span_link(ph) is None
+        assert span_owner(ph) is None
+        assert plan_identity(ph) is None
+
+
+# ---- leaf guard -------------------------------------------------------------
+
+class TestLeafGuard:
+    def test_collective_parent_is_dropped(self):
+        parent = _span("collective", 0.0, 10.0, op="allreduce_grad")
+        child = _span("plan_stage", 2.0, 4.0, plan="hier", scope="intra",
+                      link="ici")
+        alone = _span("plan_stage", 12.0, 13.0, plan="hier",
+                      scope="intra", link="ici")
+        leaves = leaf_comm_spans([parent, child, alone])
+        assert child in leaves and alone in leaves
+        assert parent not in leaves
+
+    def test_partial_overlap_keeps_both(self):
+        a = _span("fsdp", 0.0, 2.0, link="ici")
+        b = _span("plan_stage", 1.0, 3.0, plan="alltoall", scope="intra",
+                  link="ici")
+        assert leaf_comm_spans([a, b]) == [a, b]
+
+
+# ---- occupancy, overlap, rates ----------------------------------------------
+
+class TestOccupancy:
+    def test_timelines_and_overlap_matrix(self):
+        tl = occupancy_timelines({0: [_tree()]})
+        assert tl["ici"]["fsdp"] == [(100.010, 100.030)]
+        assert tl["ici"]["moe"] == [(100.020, 100.040)]
+        assert tl["dcn"]["control"] == [(100.065, 100.070)]
+        m = overlap_matrix(tl)
+        assert m["ici"] == {("fsdp", "moe"): pytest.approx(0.010)}
+        # the dcn owners (moe inter hop, control bcast) never overlap
+        assert m["dcn"] == {}
+
+    def test_link_rates_arithmetic(self):
+        rates = link_rates({0: [_tree()]})
+        ici = rates["ici"]
+        assert ici["n_spans"] == 2 and ici["bytes"] == 4_000_000
+        assert ici["span_s"] == pytest.approx(0.040)
+        assert ici["busy_s"] == pytest.approx(0.030)
+        assert ici["contended_s"] == pytest.approx(0.010)
+        assert ici["solo_s"] == pytest.approx(0.020)
+        assert ici["modeled_gbps"] == pytest.approx(4e6 / 0.040 / 1e9)
+        assert ici["effective_gbps"] == pytest.approx(4e6 / 0.030 / 1e9)
+        assert ici["derate"] == pytest.approx(
+            ici["effective_gbps"] / ici["modeled_gbps"])
+        for row in rates.values():
+            assert row["contended_s"] <= row["busy_s"] + 1e-12
+            assert row["busy_s"] <= row["span_s"] + 1e-12
+
+    def test_static_rates_annotation(self):
+        rates = link_rates({0: [_tree()]}, modeled_gbps={"ici": 1.0})
+        assert rates["ici"]["static_gbps"] == 1.0
+        assert rates["ici"]["vs_static"] == pytest.approx(
+            rates["ici"]["effective_gbps"])
+        assert "static_gbps" not in rates["dcn"]
+
+    def test_feed_link_observations_skips_empty(self):
+        class Stub:
+            calls = []
+
+            def add(self, link, nbytes, busy_s):
+                self.calls.append((link, nbytes, busy_s))
+
+        stub = Stub()
+        feed_link_observations(stub, {
+            "ici": {"bytes": 100, "busy_s": 0.5},
+            "dcn": {"bytes": 0, "busy_s": 1.0},      # no traffic
+            "x": {"bytes": 5, "busy_s": 0.0},        # no busy window
+        })
+        assert stub.calls == [("ici", 100, 0.5)]
+
+    def test_occupancy_from_raw_events(self):
+        occ = occupancy_from_events(_events())
+        assert occ["ici"]["fsdp"][0] == (
+            pytest.approx(100.010), pytest.approx(100.030))
+        assert occ["ici"]["moe"][0] == (
+            pytest.approx(100.020), pytest.approx(100.040))
+        assert "control" in occ["dcn"]
+
+
+# ---- the contention/v1 report -----------------------------------------------
+
+class TestContentionReport:
+    def test_two_rank_report_with_clock_offsets(self):
+        # rank 1's clock runs 0.35s behind; the offsets realign it so
+        # both ranks' coincident traffic merges into ONE busy window
+        rep = contention_report({0: _events(100.0), 1: _events(99.65)},
+                                offsets={1: 0.35})
+        assert rep["schema"] == "contention/v1"
+        assert rep["n_ranks"] == 2 and rep["n_steps"] == 2
+        assert rep["links"] == ["dcn", "ici"]
+        assert rep["timelines"]["ici"]["fsdp"]["busy_s"] == \
+            pytest.approx(0.020)
+        rows = {(r["link"], tuple(r["owners"])): r["contended_s"]
+                for r in rep["overlap"]}
+        assert rows[("ici", ("fsdp", "moe"))] == pytest.approx(0.010)
+        # occupancy reconciles with the attribution buckets on every
+        # (rank, step, link) row
+        assert rep["consistency_ok"]
+        assert len(rep["consistency"]) == 4  # 2 ranks x 1 step x 2 links
+        by_key = {(r["rank"], r["link"]): r for r in rep["consistency"]}
+        ici0 = by_key[(0, "ici")]
+        assert ici0["occupancy_s"] == pytest.approx(0.030)
+        assert ici0["shaved_s"] == pytest.approx(0.0)
+        assert ici0["bucket_s"] == pytest.approx(0.030)
+
+    def test_consistency_flags_a_mismatch_row(self):
+        # direct call on trees: occupancy and buckets agree by
+        # construction, so every row is ok and carries the iteration
+        rows = attribution_consistency({0: [_tree()]})
+        assert rows and all(r["ok"] for r in rows)
+        assert {r["link"] for r in rows} == {"ici", "dcn"}
+        assert all(r["iteration"] == 1 for r in rows)
+
+
+# ---- streaming fleet telemetry ----------------------------------------------
+
+@pytest.fixture
+def enabled_obs():
+    reset_flight_recorder()
+    obs.enable()
+    obs.get_registry().reset()
+    yield obs
+    obs.get_registry().reset()
+    reset_flight_recorder()
+    obs.disable()
+
+
+class TestTelemetryAggregator:
+    def _record_window(self, fr):
+        """A real-clock window: an FSDP gather straddled by a MoE hop
+        (guaranteed overlap), plus one step marker."""
+        fs = dict(bucket=0, link="ici", nbytes=1 << 20)
+        moe = dict(plan="alltoall_hier", op="all_to_all", stage=0,
+                   scope="intra", link="ici", nbytes=1 << 16)
+        fr.record("fsdp_gather_begin", **fs)
+        time.sleep(0.002)
+        fr.record("plan_stage_begin", **moe)
+        time.sleep(0.002)
+        fr.record("fsdp_gather_end", **fs)
+        time.sleep(0.002)
+        fr.record("plan_stage_end", **moe)
+        fr.record_step(0.05, 1)
+
+    def test_single_process_fold(self, enabled_obs):
+        fr = get_flight_recorder()
+        self._record_window(fr)
+        reg = obs.get_registry()
+        h = reg.streaming_histogram("serving_ttft_seconds")
+        for v in (0.010, 0.020, 0.040):
+            h.observe(v, model="m0")
+
+        agg = TelemetryAggregator(None)
+        fleet = agg.collect(5)
+        assert fleet is not None
+        assert fleet["schema"] == SCHEMA and fleet["kind"] == \
+            "fleet_telemetry"
+        assert fleet["step"] == 5 and fleet["n_ranks"] == 1
+        assert set(fleet["occupancy"]["ici"]) == {"fsdp", "moe"}
+        assert fleet["occupancy"]["ici"]["fsdp"]["busy_s"] > 0
+        rows = {tuple(r["owners"]): r["contended_s"]
+                for r in fleet["overlap"] if r["link"] == "ici"}
+        assert rows.get(("fsdp", "moe"), 0.0) > 0.0
+        assert fleet["step_time"]["0"] == pytest.approx(0.05)
+        assert fleet["stragglers"] == []  # needs >= 2 ranks
+        slo = fleet["slo"]["serving_ttft_seconds"]
+        assert slo["count"] == 3 and slo["sum"] == pytest.approx(0.070)
+        assert set(slo["quantiles"]) == {"p50", "p95", "p99"}
+        assert 0.010 <= slo["quantiles"]["p50"] <= 0.040
+        # the SLO percentiles are published back as fleet gauges
+        g = reg.get("fleet_serving_ttft_seconds")
+        assert g is not None
+        assert g.value(quantile="p50") == slo["quantiles"]["p50"]
+
+    def test_window_cursor_ships_each_event_once(self, enabled_obs):
+        fr = get_flight_recorder()
+        self._record_window(fr)
+        agg = TelemetryAggregator(None)
+        first = agg.collect(1)
+        assert first["occupancy"]  # window 1 saw the traffic
+        second = agg.collect(2)
+        assert second["occupancy"] == {}  # nothing new since cursor
+        assert second["step_time"] == {}
+        self._record_window(fr)
+        third = agg.collect(3)
+        assert "ici" in third["occupancy"]
+
+    def test_dropped_events_delta(self, enabled_obs):
+        from chainermn_tpu.observability import FlightRecorder
+        fr = FlightRecorder(capacity=4)
+        agg = TelemetryAggregator(None)
+        agg._fr = fr
+        for i in range(10):
+            fr.record("ev", i=i)
+        doc = agg.collect(1)
+        assert doc["dropped_events"] == 6
+        # the next window reports only NEW drops
+        doc = agg.collect(2)
+        assert doc["dropped_events"] == 0

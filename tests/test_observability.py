@@ -33,6 +33,7 @@ from chainermn_tpu.observability import (
     summarize_durations,
     write_snapshot_jsonl,
 )
+from chainermn_tpu.observability.registry import StreamingHistogram
 from chainermn_tpu.observability.straggler import StragglerDetector, StepTelemetry
 
 
@@ -187,6 +188,98 @@ class TestSinks:
         assert "# TYPE chainermn_tpu_comm_calls_total counter" in text
         assert "# TYPE chainermn_tpu_step_seconds summary" in text
         assert "# TYPE chainermn_tpu_devices gauge" in text
+
+    def test_prometheus_sanitizes_metric_and_label_names(self):
+        # a "plan:inter" seam in a metric name or a "wire-dtype" label
+        # key must not emit lines every scraper rejects
+        r = MetricsRegistry()
+        r.counter("plan:inter.bytes").inc(7, **{"wire-dtype": "bf16"})
+        r.gauge("9devices").set(1)
+        text = prometheus_text(r.snapshot())
+        assert ('chainermn_tpu_plan:inter_bytes_total'
+                '{wire_dtype="bf16"} 7') in text
+        assert "chainermn_tpu_9devices 1" in text
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert not name[0].isdigit()
+            assert all(c.isalnum() or c in "_:" for c in name)
+
+    def test_prometheus_streaming_histogram_native_buckets(self):
+        r = MetricsRegistry()
+        h = r.streaming_histogram("ttft", lo=0.001, hi=1.0,
+                                  buckets_per_decade=3)
+        for v in (0.002, 0.02, 0.2):
+            h.observe(v, model="m0")
+        text = prometheus_text(r.snapshot())
+        assert "# TYPE chainermn_tpu_ttft histogram" in text
+        assert "# TYPE chainermn_tpu_ttft_quantile gauge" in text
+        buckets = [l for l in text.splitlines()
+                   if l.startswith("chainermn_tpu_ttft_bucket")]
+        # cumulative counts end in the +Inf bucket carrying the total
+        assert buckets[-1].endswith(" 3") and 'le="+Inf"' in buckets[-1]
+        counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert counts == sorted(counts)
+        assert 'chainermn_tpu_ttft_count{model="m0"} 3' in text
+        assert 'chainermn_tpu_ttft_sum{model="m0"}' in text
+        assert 'quantile="0.5"' in text
+
+
+# ---- streaming histogram (the fleet-mergeable latency kind) -----------------
+
+class TestStreamingHistogram:
+    def test_observe_count_sum_quantile(self):
+        h = StreamingHistogram("lat", lo=1e-3, hi=1e2)
+        for v in (0.01, 0.02, 0.04, 0.08):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(0.15)
+        q50 = h.quantile(0.5)
+        assert 0.01 <= q50 <= 0.04  # exact to bucket resolution
+        assert h.quantile(0.5, model="never") is None
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_state_merge_roundtrip_is_exact(self):
+        a = StreamingHistogram("lat")
+        b = StreamingHistogram("lat")
+        for v in (0.01, 0.03):
+            a.observe(v, model="m")
+        for v in (0.02, 0.05, 0.09):
+            b.observe(v, model="m")
+        fleet = StreamingHistogram("lat")
+        fleet.merge(a.state(model="m"), model="m")
+        fleet.merge(b.state(model="m"), model="m")
+        assert fleet.count(model="m") == 5
+        assert fleet.sum(model="m") == pytest.approx(0.20)
+        # fleet percentiles equal observing the union directly
+        union = StreamingHistogram("lat")
+        for v in (0.01, 0.03, 0.02, 0.05, 0.09):
+            union.observe(v, model="m")
+        for q in (0.5, 0.95, 0.99):
+            assert fleet.quantile(q, model="m") == \
+                pytest.approx(union.quantile(q, model="m"))
+
+    def test_merge_refuses_grid_mismatch(self):
+        a = StreamingHistogram("lat", lo=1e-3, hi=1e2)
+        b = StreamingHistogram("lat", lo=1e-5, hi=1e3)
+        a.observe(0.01)
+        with pytest.raises(ValueError, match="buckets"):
+            b.merge(a.state())
+
+    def test_bad_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            StreamingHistogram("x", lo=0.0, hi=1.0)
+        with pytest.raises(ValueError):
+            StreamingHistogram("x", lo=1.0, hi=0.5)
+
+    def test_registry_factory_and_type_conflict(self):
+        r = MetricsRegistry()
+        h = r.streaming_histogram("x")
+        assert r.streaming_histogram("x") is h
+        with pytest.raises(TypeError, match="already registered"):
+            r.histogram("x")
 
 
 # ---- instrumented communicator ----------------------------------------------

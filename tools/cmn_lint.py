@@ -58,13 +58,70 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-hlo", action="store_true",
                    help="skip compiling the step (jaxpr-only rules; "
                         "faster, but async-pair/wire-dtype need HLO)")
+    p.add_argument("--events", metavar="PATH", default=None,
+                   help="lint RECORDED flight events instead of an "
+                        "entry point: a flight_<rank>.json dump, a "
+                        "directory of them, or a raw JSON event list — "
+                        "runs the dynamic rules (default: "
+                        "overlapping-collectives) over the spans "
+                        "rebuilt from the recording")
     p.add_argument("--list", action="store_true", dest="list_entries",
                    help="list entry points and rules, then exit")
     return p
 
 
+def _load_events(path: str) -> dict:
+    """``{rank: events}`` from a flight dump, a directory of
+    ``flight_<rank>.json`` dumps, or a bare JSON event list."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(path, "flight_*.json"))) \
+        if os.path.isdir(path) else [path]
+    if not paths:
+        raise SystemExit(f"cmn-lint --events: no flight_*.json under {path}")
+    out = {}
+    for i, p in enumerate(paths):
+        with open(p) as fh:
+            doc = json.load(fh)
+        if isinstance(doc, list):
+            out[i] = doc
+        else:
+            out[int(doc.get("rank", i))] = doc.get("events", [])
+    return out
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+
+    if args.events:
+        from chainermn_tpu.analysis.lint import lint_step
+        rules = args.rules.split(",") if args.rules \
+            else ["overlapping-collectives"]
+        rep = lint_step(None, flight_events=_load_events(args.events),
+                        rules=rules, hlo=False, raise_on_error=False,
+                        name=f"events:{args.events}")
+        doc = {
+            "suite": "cmn_lint",
+            "entry": f"events:{args.events}",
+            "ok": rep.ok,
+            "findings": [f.as_dict() for f in rep.findings],
+            "reports": [rep.to_json()],
+        }
+        if args.out:
+            os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                        exist_ok=True)
+            with open(args.out, "w") as fh:
+                json.dump(doc, fh, indent=2)
+                fh.write("\n")
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            print(rep.render_text())
+            verdict = "CLEAN" if rep.ok else \
+                f"{len(rep.errors)} ERROR FINDING(S)"
+            print(f"cmn-lint {doc['entry']}: {verdict} "
+                  f"({len(rep.findings)} finding(s))")
+        return 0 if doc["ok"] else 1
 
     if not args.list_entries:
         # Real accelerators win; otherwise bring up a virtual CPU mesh so

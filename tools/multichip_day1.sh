@@ -172,11 +172,29 @@ run 0 "$OUT/ATTRIBUTION_$ROUND.json" \
         --dump-dir '$OUT/attr_flight_$ROUND' > /dev/null"
 
 run 0 "$OUT/TRACING_OVERHEAD_$ROUND.json" \
-    "span-tracing overhead A/B: hierarchical allreduce_grad with the flight recorder off vs on; perf gate holds tracing_overhead_pct under 3%" -- \
+    "span-tracing overhead A/B: hierarchical allreduce_grad with the flight recorder off vs on (the on-arm also runs the streaming telemetry aggregator); perf gate holds tracing_overhead_pct under 3%" -- \
     bash -c "env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         $PY_TPU benchmarks/bench_allreduce.py \
         --traced '$OUT/TRACING_OVERHEAD_$ROUND.json' \
         --iters 10 --repeats 3 --communicators hierarchical > /dev/null"
+
+# ---- link contention: 2-process FSDP + MoE overlap observatory --------
+# Hardware-free (2 controllers x 4-way CPU meshes): bucketed-FSDP
+# training plus the hierarchical all-to-all dispatch schedule on the
+# same world, then the full observatory cut — per-link occupancy
+# timelines, the fsdp x moe overlap matrix, effective-vs-modeled GB/s
+# under contention, the occupancy-vs-attribution-bucket reconciliation,
+# the `overlapping-collectives` lint firing on the same events, and the
+# streaming fleet-telemetry gather over the live control plane
+# (docs/observability.md "Contention & fleet telemetry").  Render with
+# `obs_report --flight --contention <dump dir>`.  On a slice, re-run
+# WITHOUT the platform override: real concurrent issue streams replace
+# the modeled-overlap shift.
+run 0 "$OUT/CONTENTION_$ROUND.json" \
+    "link-contention smoke: 2-process FSDP gathers + MoE all-to-all; overlap matrix must name fsdp x moe on ici, occupancy must reconcile with the attribution buckets, and the overlapping-collectives lint must fire" -- \
+    bash -c "env JAX_PLATFORMS=cpu \
+        $PY_TPU tools/contention_smoke.py --out '$OUT/CONTENTION_$ROUND.json' \
+        --dump-dir '$OUT/cont_flight_$ROUND' > /dev/null"
 
 run 1 "$OUT/PERF_GATE_$ROUND.json" \
     "perf gate: fresh bench artifacts vs checked-in budgets (tools/perf_budgets.json; >3% regression on any tracked throughput FAILS this leg)" -- \

@@ -461,6 +461,125 @@ def attribution_section(records: List[dict]) -> str:
     return "\n\n".join(parts)
 
 
+def _render_contention_doc(doc: dict) -> str:
+    """Tables for one ``contention/v1`` document (the post-hoc,
+    clock-corrected observatory cut — contention_smoke.py /
+    ``--flight`` rebuild it from flight dumps)."""
+    parts = []
+    head = (f"contention report ({doc.get('n_ranks', '?')} rank(s), "
+            f"{doc.get('n_steps', '?')} step(s), links: "
+            f"{','.join(doc.get('links', [])) or '-'})")
+    rows = []
+    for link in sorted(doc.get("timelines", {})):
+        for owner, row in sorted(doc["timelines"][link].items()):
+            rows.append([link, owner, _fmt_s(row.get("busy_s")),
+                         str(row.get("n_intervals", "-"))])
+    parts.append(head + ("\n" + _table(
+        ["link", "owner", "busy", "intervals"], rows)
+        if rows else "\nno comm spans in the window"))
+    orows = [[str(o.get("link", "?")),
+              " + ".join(o.get("owners", [])),
+              _fmt_s(o.get("contended_s"))]
+             for o in doc.get("overlap", [])]
+    if orows:
+        parts.append("overlap matrix (pairwise contended seconds)\n"
+                     + _table(["link", "owners", "contended"], orows))
+    else:
+        parts.append("overlap matrix: no cross-subsystem overlap observed")
+    rrows = []
+    for link, r in sorted((doc.get("rates") or {}).items()):
+        rrows.append([
+            link, str(r.get("n_spans", "-")),
+            _fmt_bytes(r.get("bytes", 0)),
+            _fmt_s(r.get("busy_s")), _fmt_s(r.get("contended_s")),
+            f"{r.get('modeled_gbps', 0.0):.3f}",
+            f"{r.get('effective_gbps', 0.0):.3f}",
+            f"{r.get('derate', 1.0):.2f}",
+        ])
+    if rrows:
+        parts.append("link rates under overlap\n" + _table(
+            ["link", "spans", "bytes", "busy", "contended",
+             "modeled GB/s", "effective GB/s", "derate"], rrows))
+    cons = doc.get("consistency")
+    if cons is not None:
+        bad = [c for c in cons if not c.get("ok")]
+        parts.append(
+            f"attribution consistency "
+            f"(occupancy − priority shave == bucket, per rank/step/link): "
+            f"{'OK' if doc.get('consistency_ok') else 'VIOLATED'} "
+            f"({len(cons)} row(s), {len(bad)} violation(s))")
+    return "\n\n".join(parts)
+
+
+def _render_fleet_doc(doc: dict) -> str:
+    """Tables for one streaming ``fleet_telemetry`` record (the live,
+    per-window cut rank 0 folds from the control-plane gathers)."""
+    parts = []
+    head = (f"fleet telemetry @ step {doc.get('step', '?')} "
+            f"({doc.get('n_ranks', '?')} rank(s), "
+            f"dropped_events={doc.get('dropped_events', 0)})")
+    rows = []
+    for link in sorted(doc.get("occupancy", {})):
+        for owner, row in sorted(doc["occupancy"][link].items()):
+            per_rank = " ".join(
+                f"r{r}={_fmt_s(v)}" for r, v in
+                sorted(row.get("by_rank", {}).items(),
+                       key=lambda kv: int(kv[0])))
+            rows.append([link, owner, _fmt_s(row.get("busy_s")),
+                         per_rank or "-"])
+    parts.append(head + ("\n" + _table(
+        ["link", "owner", "busy", "per-rank busy"], rows)
+        if rows else "\nno comm occupancy this window"))
+    orows = [[str(o.get("link", "?")),
+              " + ".join(o.get("owners", [])),
+              _fmt_s(o.get("contended_s"))]
+             for o in doc.get("overlap", [])]
+    if orows:
+        parts.append("live overlap matrix\n"
+                     + _table(["link", "owners", "contended"], orows))
+    st = doc.get("step_time") or {}
+    if st:
+        stragglers = set(doc.get("stragglers") or [])
+        srows = [[f"r{r}", _fmt_s(v),
+                  "STRAGGLER" if int(r) in stragglers else ""]
+                 for r, v in sorted(st.items(), key=lambda kv: int(kv[0]))]
+        parts.append("per-rank mean step time\n"
+                     + _table(["rank", "mean step", ""], srows))
+    slo = doc.get("slo") or {}
+    if slo:
+        hrows = []
+        for name, row in sorted(slo.items()):
+            q = row.get("quantiles") or {}
+            hrows.append([name, str(row.get("count", "-")),
+                          _fmt_s(q.get("p50")), _fmt_s(q.get("p95")),
+                          _fmt_s(q.get("p99"))])
+        parts.append("serving SLO percentiles (fleet-merged)\n"
+                     + _table(["metric", "count", "p50", "p95", "p99"],
+                              hrows))
+    return "\n\n".join(parts)
+
+
+def contention_section(records: List[dict]) -> str:
+    """Contention lane (metrics mode): the latest streaming
+    ``fleet_telemetry`` window plus the latest post-hoc
+    ``contention_report`` document found in the JSONL."""
+    parts = []
+    fleet = [r for r in records if r.get("kind") == "fleet_telemetry"]
+    if fleet:
+        body = _render_fleet_doc(fleet[-1])
+        if len(fleet) > 1:
+            body += f"\n({len(fleet)} fleet window(s) in file, latest shown)"
+        parts.append(body)
+    cont = [r for r in records if r.get("kind") == "contention_report"]
+    if cont:
+        parts.append(_render_contention_doc(cont[-1]))
+    if not parts:
+        return ("contention: no fleet_telemetry or contention_report "
+                "records (enable MetricsReport(stream_telemetry=True), "
+                "or run tools/contention_smoke.py)")
+    return "\n\n".join(parts)
+
+
 SECTIONS = {
     "collectives": collectives_section,
     "steps": steps_section,
@@ -469,6 +588,7 @@ SECTIONS = {
     "compression": compression_section,
     "serving": serving_section,
     "attribution": attribution_section,
+    "contention": contention_section,
 }
 
 
@@ -803,12 +923,28 @@ def write_trace(dumps: List[dict], out_path: str) -> str:
     return out_path
 
 
+def flight_contention_section(dumps: List[dict]) -> str:
+    """Contention lane (flight mode): rebuild the full clock-corrected
+    ``contention/v1`` document from the dumps' events and render it.
+    Empty string when the dumps carry no comm spans."""
+    try:
+        from chainermn_tpu.observability import contention as _cont
+        doc = _cont.contention_report(_dump_events_by_rank(dumps),
+                                      offsets=_dump_offsets(dumps))
+    except Exception as e:  # noqa: BLE001 — report tool must not die
+        return f"contention: failed to build occupancy timelines ({e})"
+    if not doc.get("links"):
+        return ""
+    return _render_contention_doc(doc)
+
+
 def flight_report(dumps: List[dict], max_events: int = 60) -> str:
     parts = [
         flight_summary_section(dumps),
         flight_desync_section(dumps),
         flight_timeline_section(dumps, max_events=max_events),
         flight_fsdp_lane_section(dumps),
+        flight_contention_section(dumps),
         flight_attribution_section(dumps),
     ]
     return "\n\n".join(p for p in parts if p)
@@ -861,6 +997,38 @@ def lint_section(doc: dict) -> str:
     return head + "\n" + _table(["sev", "rule", "target", "finding"], rows)
 
 
+def _live_loop(path: str, names: List[str], interval: float = 2.0) -> int:
+    """``--live``: tail-follow the metrics JSONL and re-render the
+    selected sections whenever the file grows (the streaming aggregator
+    appends a fleet_telemetry record per emit, so the contention lane
+    updates live)."""
+    import time as _time
+
+    from chainermn_tpu.observability import read_jsonl
+
+    last_size = None
+    try:
+        while True:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            if size != last_size:
+                last_size = size
+                records = read_jsonl(path) if size > 0 else []
+                body = "\n\n".join(SECTIONS[n](records) for n in names) \
+                    if records else f"waiting for records in {path} ..."
+                sys.stdout.write(
+                    "\033[2J\033[H"
+                    f"obs_report --live {path} "
+                    f"(refresh {interval:g}s, ctrl-c to exit)\n\n"
+                    + body + "\n")
+                sys.stdout.flush()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", nargs="*",
@@ -879,6 +1047,20 @@ def main(argv=None) -> int:
                          "(metrics mode: step_attribution records; with "
                          "--flight: per-step buckets + critical path "
                          "rebuilt from the dumps)")
+    ap.add_argument("--contention", action="store_true",
+                    help="print only the link-contention lane (metrics "
+                         "mode: fleet_telemetry / contention_report "
+                         "records; with --flight: the clock-corrected "
+                         "occupancy timelines + overlap matrix rebuilt "
+                         "from the dumps)")
+    ap.add_argument("--live", action="store_true",
+                    help="tail-follow the metrics JSONL and re-render "
+                         "whenever it grows (defaults to the contention "
+                         "+ steps + straggler lanes; combine with "
+                         "--section/--contention to pick one)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="--live refresh poll interval in seconds "
+                         "(default 2.0)")
     ap.add_argument("--flight", action="store_true",
                     help="merge per-rank flight_<rank>.json hang dumps "
                          "into one timeline")
@@ -913,6 +1095,9 @@ def main(argv=None) -> int:
             return 1
         if args.attribution:
             out = flight_attribution_section(dumps)
+        elif args.contention:
+            out = flight_contention_section(dumps) \
+                or "contention: no comm spans in the dumps"
         else:
             out = flight_report(dumps, max_events=args.events)
         if args.trace:
@@ -933,6 +1118,19 @@ def main(argv=None) -> int:
     if not args.path:
         ap.error("a metrics JSONL path is required (or --lint/--flight)")
 
+    if args.live:
+        section = args.section
+        for flag, name in ((args.compression, "compression"),
+                           (args.serving, "serving"),
+                           (args.attribution, "attribution"),
+                           (args.contention, "contention")):
+            if flag and not section:
+                section = name
+        live_names = [section] if section else \
+            ["contention", "steps", "straggler"]
+        return _live_loop(args.path[0], live_names,
+                          interval=args.interval)
+
     from chainermn_tpu.observability import read_jsonl
 
     records = read_jsonl(args.path[0])
@@ -945,9 +1143,11 @@ def main(argv=None) -> int:
         args.section = "serving"
     if args.attribution and not args.section:
         args.section = "attribution"
+    if args.contention and not args.section:
+        args.section = "contention"
     names = [args.section] if args.section else \
         ["steps", "collectives", "straggler", "bench", "compression",
-         "serving", "attribution"]
+         "serving", "attribution", "contention"]
     out = "\n\n".join(SECTIONS[n](records) for n in names)
     if lint_out:
         out += "\n\n" + lint_out
