@@ -664,8 +664,11 @@ def _overlapping_collectives(ctx) -> List[Finding]:
     modeled GB/s — the contention blind spot ROADMAP item 4 names.
     Spans sharing one identity are one co-tuned decision (a striped
     plan's concurrent groups split the link on purpose) and are never
-    flagged.  Severity is ``warning``: contention is a throughput bug,
-    not a wedge.  Runtime evidence, not a compile-time proof — feed it
+    flagged.  Full nesting counts: one identity's span time-containing
+    another's IS overlap (the worst case — the inner transfer runs
+    entirely under contention); only a true wrapper-over-decomposition
+    pair (``leaf_comm_spans``) is exempt.  Severity is ``warning``:
+    contention is a throughput bug, not a wedge.  Runtime evidence, not a compile-time proof — feed it
     the flight events of a representative window (``flight_events=``,
     or a flight dump's ``events`` via ``cmn_lint --events``).
     """

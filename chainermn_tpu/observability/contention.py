@@ -35,10 +35,17 @@ link class* instead of per step:
   ``tools/contention_smoke.py`` commits as ``CONTENTION_r16.json``.
 
 Double-count guard: a trace-time ``collective`` span *contains* its
-plan-stage children, so unioning both under different owners would
-manufacture fake self-contention.  Occupancy therefore counts only
-**leaf** comm spans (:func:`leaf_comm_spans`); the consistency check
-uses the full classified union on purpose — that is what
+plan-stage children — the same wire traffic recorded twice — so
+unioning both under different owners would manufacture fake
+self-contention.  Occupancy therefore drops those wrapper parents
+(:func:`leaf_comm_spans`).  The guard is deliberately narrow: only a
+same-rank known *decomposition* pair (a ``collective`` wrapper over its
+``plan_stage`` stages or a nested instrumented call, an ``object`` op
+over the ops composing it) marks a parent; mere time-containment — one
+rank's FSDP gather spanning another subsystem's hop, on the same rank
+or across ranks — is genuine concurrency and is KEPT, because that is
+exactly the contention this module exists to measure.  The consistency
+check uses the full classified union on purpose — that is what
 :func:`~.attribution.attribute_step` buckets.
 """
 
@@ -134,24 +141,53 @@ def plan_identity(span: Span) -> Optional[str]:
     return None
 
 
+#: (parent kind, child kind) pairs that are true traffic
+#: decompositions: the parent is a host-side wrapper whose wire bytes
+#: its contained child re-emits.  A trace-time ``collective`` covers
+#: the ``plan_stage`` edges of its own compiled plan (and a nested
+#: instrumented call); a control-plane ``object`` op covers the object
+#: ops it is composed of.  Everything else that merely time-contains a
+#: comm span — an FSDP gather spanning a MoE hop — is independent
+#: traffic contending for the link, not a re-count of it.
+_DECOMPOSITION = frozenset({
+    ("collective", "plan_stage"),
+    ("collective", "collective"),
+    ("object", "object"),
+})
+
+
 def leaf_comm_spans(spans: Sequence[Span]) -> List[Span]:
-    """Comm spans that do not CONTAIN another comm span — the
-    double-count guard.  A trace-time ``collective`` parent covers its
-    plan-stage children; counting both under different owners would
-    read as self-contention.  Works on a flat list (stack sweep over
-    ``(t0, -t1)`` order), so both tree walks and
-    :func:`~.spans.pair_events` output feed it."""
+    """Comm spans minus wrapper parents whose traffic a contained span
+    re-emits — the double-count guard.
+
+    A span is dropped ONLY when, on the SAME rank, it time-contains
+    another comm span in a known decomposition relationship
+    (:data:`_DECOMPOSITION` — e.g. a trace-time ``collective`` wrapper
+    over its ``plan_stage`` children).  Plain containment is NOT
+    parenthood: a rank-0 FSDP gather that happens to span a rank-1 MoE
+    all-to-all, or a same-rank gather spanning a concurrent hop of
+    another subsystem, is genuine concurrency — dropping either side
+    would erase the very contention signal occupancy exists to
+    measure.  Works on flat :func:`~.spans.pair_events` output and on
+    tree walks alike (a per-rank stack sweep over ``(t0, -t1)``
+    order)."""
     comm = [sp for sp in spans if span_link(sp) is not None]
-    comm.sort(key=lambda s: (s.t0, -s.t1))
     non_leaf = set()
-    stack: List[Span] = []
+    by_rank: Dict[int, List[Span]] = {}
     for sp in comm:
-        while stack and not (sp.t0 >= stack[-1].t0 - _EPS
-                             and sp.t1 <= stack[-1].t1 + _EPS):
-            stack.pop()
-        if stack:
-            non_leaf.add(id(stack[-1]))
-        stack.append(sp)
+        by_rank.setdefault(sp.rank, []).append(sp)
+    for rank_spans in by_rank.values():
+        rank_spans.sort(key=lambda s: (s.t0, -s.t1))
+        stack: List[Span] = []
+        for sp in rank_spans:
+            while stack and not (sp.t0 >= stack[-1].t0 - _EPS
+                                 and sp.t1 <= stack[-1].t1 + _EPS):
+                stack.pop()
+            for anc in stack:
+                if (anc.kind, sp.kind) in _DECOMPOSITION:
+                    non_leaf.add(id(anc))
+            stack.append(sp)
+    comm.sort(key=lambda s: (s.t0, -s.t1))
     return [sp for sp in comm if id(sp) not in non_leaf]
 
 

@@ -30,7 +30,12 @@ WITHOUT the clock handshake (that would cost a collective per window).
 Same-host ranks share a wall clock so the live overlap matrix is
 exact there; across hosts it is approximate, and the post-hoc
 :func:`~.contention.contention_report` (clock-corrected) is the
-authoritative cut.
+authoritative cut.  Interval lists are additionally capped at
+``max_intervals`` per (link, owner) per window; a capped row ships
+``truncated``/``dropped_s`` and the fleet document lists the affected
+``(link, owner)`` pairs under ``truncated`` — union ``busy_s`` and the
+live matrix are lower bounds for those (per-rank ``by_rank`` busy
+stays exact: it is computed before the cap).
 """
 
 from __future__ import annotations
@@ -106,7 +111,14 @@ class TelemetryAggregator:
     def local_summary(self, step: int) -> dict:
         """The compact summary this rank ships: occupancy per (link,
         owner) with capped interval lists, step durations in the
-        window, dropped-event delta, and serving histogram states."""
+        window, dropped-event delta, and serving histogram states.
+
+        When a (link, owner) timeline exceeds ``max_intervals`` only
+        the newest intervals ship; the row then carries ``truncated``
+        and ``dropped_s`` (busy seconds of the intervals cut) so the
+        fleet fold can mark its live matrix a lower bound instead of
+        silently undercounting — ``busy_s`` itself is always the full
+        uncapped window total."""
         events = self._window_events()
         occ = contention.occupancy_from_events(events, rank=self.rank)
         occ_doc: Dict[str, dict] = {}
@@ -114,11 +126,15 @@ class TelemetryAggregator:
             occ_doc[link] = {}
             for owner in sorted(occ[link]):
                 ivs = occ[link][owner]
+                dropped = ivs[:-self._max_intervals] \
+                    if len(ivs) > self._max_intervals else []
                 occ_doc[link][owner] = {
                     "busy_s": _total(ivs),
                     "n_intervals": len(ivs),
                     "intervals": [[a, b]
                                   for a, b in ivs[-self._max_intervals:]],
+                    "truncated": bool(dropped),
+                    "dropped_s": _total(dropped),
                 }
         step_durs = [float(e["dur_s"]) for e in events
                      if e.get("kind") == "step" and e.get("dur_s")]
@@ -167,6 +183,7 @@ class TelemetryAggregator:
         # the live overlap matrix on the merged timelines
         timelines: Dict[str, Dict[str, list]] = {}
         per_rank_busy: Dict[str, dict] = {}
+        dropped_s: Dict[str, Dict[str, float]] = {}
         for s in summaries:
             for link, owners in s.get("occupancy", {}).items():
                 for owner, row in owners.items():
@@ -175,12 +192,26 @@ class TelemetryAggregator:
                         tuple(iv) for iv in row.get("intervals", []))
                     per_rank_busy.setdefault(link, {}).setdefault(
                         owner, {})[str(s["rank"])] = row.get("busy_s", 0.0)
+                    if row.get("truncated"):
+                        cell = dropped_s.setdefault(link, {})
+                        cell[owner] = cell.get(owner, 0.0) \
+                            + float(row.get("dropped_s", 0.0))
         timelines = {link: {o: _merge(ivs) for o, ivs in owners.items()}
                      for link, owners in timelines.items()}
         matrix = contention.overlap_matrix(timelines)
+        # union busy_s / the live matrix only see the SHIPPED intervals;
+        # a truncated (link, owner) makes both lower bounds for this
+        # window, so the fold says so instead of undercounting silently
+        truncated = sorted(
+            [link, owner]
+            for link, owners in dropped_s.items() for owner in owners)
         occupancy_doc = {
-            link: {owner: {"busy_s": _total(ivs),
-                           "by_rank": per_rank_busy[link][owner]}
+            link: {owner: dict(
+                       {"busy_s": _total(ivs),
+                        "by_rank": per_rank_busy[link][owner]},
+                       **({"truncated": True,
+                           "dropped_s": dropped_s[link][owner]}
+                          if owner in dropped_s.get(link, {}) else {}))
                    for owner, ivs in sorted(timelines[link].items())}
             for link in sorted(timelines)}
 
@@ -238,6 +269,7 @@ class TelemetryAggregator:
             "step": int(step),
             "n_ranks": len(summaries),
             "occupancy": occupancy_doc,
+            "truncated": truncated,
             "overlap": contention._matrix_rows(matrix),
             "step_time": {str(r): m for r, m in sorted(means.items())},
             "stragglers": stragglers,

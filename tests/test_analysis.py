@@ -562,6 +562,45 @@ def test_rule_overlapping_collectives_fires_on_contended_link():
     assert f.details["ranks"] == [0]
 
 
+def test_rule_overlapping_collectives_fires_on_full_nesting():
+    """One identity's span fully time-containing another's is the
+    worst-contended case (the inner transfer runs entirely under
+    contention), not a parent/child — the rule fires for the inner
+    span's whole duration."""
+    events = (
+        _flight("fsdp_gather_begin", "fsdp_gather_end", 20.000, 20.100,
+                bucket=0, link="ici", nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 20.020, 20.080,
+                  plan="alltoall_hier", op="all_to_all", stage=0,
+                  scope="intra", link="ici", nbytes=1 << 16))
+    for i, e in enumerate(events):
+        e["seq"] = i
+    rep = lint_step(None, flight_events={0: events},
+                    rules=["overlapping-collectives"], hlo=False,
+                    raise_on_error=False, name="synthetic")
+    assert [f.rule for f in rep.findings] == ["overlapping-collectives"]
+    f = rep.findings[0]
+    assert f.details["identities"] == ["fsdp", "plan:alltoall_hier"]
+    assert f.details["contended_s"] == pytest.approx(0.060)
+
+
+def test_rule_overlapping_collectives_exempts_plan_decomposition():
+    """A trace-time collective wrapper over its OWN plan stages is one
+    decomposed transfer, not two contending ones — no finding."""
+    events = (
+        _flight("collective_begin", "collective_end", 30.000, 30.100,
+                op="allreduce_grad", op_seq=1, nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 30.020, 30.080,
+                  plan="hier", op="all-reduce", stage=0,
+                  scope="intra", link="ici", nbytes=1 << 20))
+    for i, e in enumerate(events):
+        e["seq"] = i
+    rep = lint_step(None, flight_events={0: events},
+                    rules=["overlapping-collectives"], hlo=False,
+                    raise_on_error=False)
+    assert rep.ok and rep.findings == []
+
+
 def test_rule_overlapping_collectives_ignores_cotuned_stripes():
     """Concurrent groups of ONE striped plan share a tuning identity
     (their link split is a single co-tuned decision) and never fire."""

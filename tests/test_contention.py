@@ -147,6 +147,54 @@ class TestLeafGuard:
                   link="ici")
         assert leaf_comm_spans([a, b]) == [a, b]
 
+    def test_cross_rank_containment_is_concurrency(self):
+        """Rank 0's FSDP gather time-contains rank 1's MoE hop: genuine
+        concurrency, never parent/child.  Both survive, occupancy shows
+        both owners, and the overlap matrix carries their contention —
+        the exact signal a containment-only sweep used to erase."""
+        fs = _span("fsdp", 0.0, 1.0, rank=0, link="ici", nbytes=1 << 20)
+        moe = _span("plan_stage", 0.2, 0.8, rank=1, plan="alltoall_hier",
+                    scope="intra", link="ici", nbytes=1 << 16)
+        assert leaf_comm_spans([fs, moe]) == [fs, moe]
+        tl = occupancy_timelines({0: [fs], 1: [moe]})
+        assert tl["ici"]["fsdp"] == [(0.0, 1.0)]
+        assert tl["ici"]["moe"] == [(0.2, 0.8)]
+        m = overlap_matrix(tl)
+        assert m["ici"][("fsdp", "moe")] == pytest.approx(0.6)
+        rates = link_rates({0: [fs], 1: [moe]})["ici"]
+        assert rates["busy_s"] == pytest.approx(1.0)
+        assert rates["contended_s"] == pytest.approx(0.6)
+        assert rates["bytes"] == (1 << 20) + (1 << 16)
+
+    def test_same_rank_full_nesting_across_subsystems_kept(self):
+        """An FSDP gather spanning an entire MoE hop on ONE rank is the
+        most-contended case, not a decomposition — both are leaves."""
+        fs = _span("fsdp", 0.0, 1.0, link="ici")
+        moe = _span("plan_stage", 0.2, 0.8, plan="alltoall_hier",
+                    scope="intra", link="ici")
+        assert leaf_comm_spans([fs, moe]) == [fs, moe]
+        m = overlap_matrix(occupancy_timelines({0: [fs, moe]}))
+        assert m["ici"][("fsdp", "moe")] == pytest.approx(0.6)
+
+    def test_wrapper_guard_is_same_rank_only(self):
+        """A collective wrapper only decomposes into ITS OWN rank's
+        plan stages — containing another rank's stage keeps both."""
+        parent = _span("collective", 0.0, 10.0, rank=0,
+                       op="allreduce_grad")
+        child = _span("plan_stage", 2.0, 4.0, rank=1, plan="hier",
+                      scope="intra", link="ici")
+        assert leaf_comm_spans([parent, child]) == [parent, child]
+
+    def test_nested_wrapper_kinds_are_dropped(self):
+        """collective-over-collective and object-over-object are
+        nested instrumented calls re-recording the same traffic."""
+        outer = _span("collective", 0.0, 5.0, op="multi_node_mean_grad")
+        inner = _span("collective", 1.0, 2.0, op="allreduce_grad")
+        assert leaf_comm_spans([outer, inner]) == [inner]
+        wrap = _span("object", 0.0, 3.0, op="serving_plan_bcast")
+        op = _span("object", 0.5, 1.5, op="bcast_obj")
+        assert leaf_comm_spans([wrap, op]) == [op]
+
 
 # ---- occupancy, overlap, rates ----------------------------------------------
 
@@ -314,6 +362,36 @@ class TestTelemetryAggregator:
         self._record_window(fr)
         third = agg.collect(3)
         assert "ici" in third["occupancy"]
+
+    def test_truncated_interval_lists_are_flagged(self, enabled_obs):
+        """Past ``max_intervals`` per (link, owner) the shipped list is
+        capped: the summary row carries truncated/dropped_s, the fleet
+        document names the pair, and the (lower-bound) fleet busy_s is
+        visibly below the exact uncapped by_rank busy."""
+        fr = get_flight_recorder()
+        fs = dict(bucket=0, link="ici", nbytes=1 << 10)
+        for _ in range(4):
+            fr.record("fsdp_gather_begin", **fs)
+            time.sleep(0.001)
+            fr.record("fsdp_gather_end", **fs)
+            time.sleep(0.001)
+        agg = TelemetryAggregator(None, max_intervals=2)
+        doc = agg.collect(1)
+        row = doc["occupancy"]["ici"]["fsdp"]
+        assert row["truncated"] is True and row["dropped_s"] > 0.0
+        assert doc["truncated"] == [["ici", "fsdp"]]
+        # union busy_s only sees the 2 shipped intervals; by_rank busy
+        # is the full 4-interval window (computed before the cap)
+        assert row["by_rank"]["0"] > row["busy_s"]
+
+    def test_uncapped_window_carries_no_truncation(self, enabled_obs):
+        fr = get_flight_recorder()
+        self._record_window(fr)
+        doc = TelemetryAggregator(None).collect(1)
+        assert doc["truncated"] == []
+        for owners in doc["occupancy"].values():
+            for row in owners.values():
+                assert "truncated" not in row
 
     def test_dropped_events_delta(self, enabled_obs):
         from chainermn_tpu.observability import FlightRecorder

@@ -169,12 +169,11 @@ def shift_bundle(events):
     within the gather span (and therefore within the step tree).
     The bundle starts just BEFORE the end edge of the latest-ending
     completed fsdp span in a step and runs toward the step's end, so
-    its first hop provably STRADDLES that edge — partial overlap,
-    because the leaf guard
+    its first hop provably STRADDLES that edge.  (The leaf guard
     (:func:`~chainermn_tpu.observability.contention.leaf_comm_spans`)
-    would drop whichever span fully contained the other and read zero
-    contention.  Nothing can contain the straddling hop either: the
-    anchor is the MAXIMUM fsdp end inside the step.  The FSDP edge
+    keeps cross-subsystem containment as genuine concurrency, so full
+    nesting would count too — the straddle just makes the overlap
+    window hand-computable: exactly ``eps`` past the anchor edge.)  The FSDP edge
     stream is rank-gated to global device 0, so ranks without fsdp
     edges fall back to the middle half of their first step window —
     inside a step tree, just not contended.  Returns ``(events,

@@ -525,11 +525,21 @@ def _render_fleet_doc(doc: dict) -> str:
                 f"r{r}={_fmt_s(v)}" for r, v in
                 sorted(row.get("by_rank", {}).items(),
                        key=lambda kv: int(kv[0])))
-            rows.append([link, owner, _fmt_s(row.get("busy_s")),
-                         per_rank or "-"])
+            busy = _fmt_s(row.get("busy_s"))
+            if row.get("truncated"):
+                busy = f">={busy}"  # shipped intervals capped
+            rows.append([link, owner, busy, per_rank or "-"])
     parts.append(head + ("\n" + _table(
         ["link", "owner", "busy", "per-rank busy"], rows)
         if rows else "\nno comm occupancy this window"))
+    trunc = doc.get("truncated") or []
+    if trunc:
+        pairs = ", ".join(f"{link}/{owner}" for link, owner in trunc)
+        parts.append(
+            f"NOTE: interval lists truncated this window for {pairs} — "
+            f"fleet busy and the live overlap matrix are lower bounds "
+            f"there (per-rank busy stays exact; the post-hoc "
+            f"contention_report is authoritative)")
     orows = [[str(o.get("link", "?")),
               " + ".join(o.get("owners", [])),
               _fmt_s(o.get("contended_s"))]
