@@ -400,6 +400,8 @@ def _replay(args):
         }
     else:
         doc["retune"] = None
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc)
     blob = json.dumps(doc, indent=2) + "\n"
     if args.replay_out:
         with open(args.replay_out, "w") as f:
@@ -412,6 +414,45 @@ def _replay(args):
     else:
         print(blob, end="")
     return doc
+
+
+def overhead_stats(off_s, on_s, collect_s_per_iter=0.0):
+    """Noise-aware summary of a paired A/B overhead measurement.
+
+    ``off_s``/``on_s`` are per-repeat times (seconds per iteration) for
+    the instrumented-off and instrumented-on arms; ``collect_s_per_iter``
+    is amortized into every on-arm sample.  Returns the published
+    ``tracing_overhead_pct`` plus the honesty fields:
+
+    * ``raw_overhead_pct`` — the min-vs-min center, sign preserved;
+    * ``per_repeat_pct`` — the paired overhead of each repeat (repeat i's
+      on arm vs repeat i's off arm), the spread's raw material;
+    * ``spread_pct`` — max-min across the paired repeats;
+    * ``noise_dominated`` — True when the spread swallows the center
+      (spread >= max(|center|, 1.0)) **or** the center is negative:
+      tracing cannot make the program faster, so a negative center is a
+      measurement-noise artifact, not a win.  When set, the published
+      pct is clamped at 0 instead of advertising the artifact.
+    """
+    off_s = [float(t) for t in off_s]
+    on_s = [float(t) + float(collect_s_per_iter) for t in on_s]
+    if not off_s or not on_s:
+        raise ValueError("overhead_stats needs at least one repeat "
+                         "per arm")
+    per_repeat = [(on - off) / off * 100.0
+                  for off, on in zip(off_s, on_s)]
+    center = (min(on_s) - min(off_s)) / min(off_s) * 100.0
+    spread = (max(per_repeat) - min(per_repeat)) \
+        if len(per_repeat) > 1 else 0.0
+    noise_dominated = spread >= max(abs(center), 1.0) or center < 0.0
+    published = max(center, 0.0) if noise_dominated else center
+    return {
+        "tracing_overhead_pct": round(published, 3),
+        "raw_overhead_pct": round(center, 3),
+        "per_repeat_pct": [round(p, 3) for p in per_repeat],
+        "spread_pct": round(spread, 3),
+        "noise_dominated": noise_dominated,
+    }
 
 
 def _traced(args):
@@ -428,7 +469,12 @@ def _traced(args):
     iterations into the on-arm time — the cost of shipping a telemetry
     window every ``iters`` steps, which is how ``MetricsReport``
     triggers it.  Each arm runs ``--repeats`` times interleaved and
-    reports its MIN (standard microbenchmark noise floor).  The written
+    reports its MIN (standard microbenchmark noise floor), guarded by
+    :func:`overhead_stats`: the artifact carries the per-repeat paired
+    overheads and their spread, and when the spread swallows the center
+    (or the center goes negative — tracing cannot speed a program up)
+    it sets ``noise_dominated: true`` and clamps the published pct at 0
+    rather than advertising measurement noise as a win.  The written
     artifact (``tracing_overhead/v1``) carries ``tracing_overhead_pct``,
     the number ``tools/perf_budgets.json`` holds under 3%.
     """
@@ -490,9 +536,10 @@ def _traced(args):
               "overhead A/B is meaningless", file=sys.stderr)
         return 1
     collect_s = min(collects) if collects else 0.0
+    per_iter_collect = collect_s / max(int(args.iters), 1)
+    stats = overhead_stats(times["off"], times["on"], per_iter_collect)
     t_off = min(times["off"])
-    t_on = min(times["on"]) + collect_s / max(int(args.iters), 1)
-    pct = (t_on - t_off) / t_off * 100.0
+    t_on = min(times["on"]) + per_iter_collect
     doc = {"schema": "tracing_overhead/v1",
            "backend": jax.default_backend(),
            "n_devices": n,
@@ -504,12 +551,21 @@ def _traced(args):
            "time_ms_on": round(t_on * 1e3, 4),
            "streaming_collect_ms": round(collect_s * 1e3, 4),
            "events_per_traced_run": events_recorded,
-           "tracing_overhead_pct": round(pct, 3),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    doc.update(stats)
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, n_devices=n, backend=doc["backend"])
+    if stats["noise_dominated"]:
+        print(f"--traced: noise-dominated measurement (center "
+              f"{stats['raw_overhead_pct']}%, spread "
+              f"{stats['spread_pct']}% over {len(times['off'])} "
+              f"repeats) — publishing clamped overhead "
+              f"{stats['tracing_overhead_pct']}%", file=sys.stderr)
     with open(args.traced, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
     print(json.dumps({"tracing_overhead_pct": doc["tracing_overhead_pct"],
+                      "noise_dominated": doc["noise_dominated"],
                       "time_ms_off": doc["time_ms_off"],
                       "time_ms_on": doc["time_ms_on"]}), flush=True)
     return doc
@@ -624,6 +680,8 @@ def _sweep(args):
         # the largest swept size's row, under a stable dotted path the
         # dcn_wire_bytes perf budget digs into
         doc["dcn_largest"] = max(dcn_summary, key=lambda r: r["bytes"])
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc)
     with open(args.sweep, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
@@ -691,6 +749,8 @@ def _census(args):
                                 "count_by_kind": by_kind}
         print(f"census {name}: {by_kind} "
               f"{[(o['op'], o['bytes']) for o in ops]}", file=sys.stderr)
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "collective_census/v1")
     with open(args.census, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")

@@ -169,6 +169,8 @@ def main():
 
 def _finish(doc, args):
     doc["ok"] = all(s.get("ok") for s in doc["stages"].values())
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "flash_64k_probe/v1")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)

@@ -187,6 +187,8 @@ def _sweep(args):
         # largest swept size, under the stable dotted path the
         # moe_alltoall_dcn_bytes perf budget digs into
         doc["dcn_largest"] = max(dcn_summary, key=lambda r: r["bytes"])
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc)
     with open(args.sweep, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
@@ -320,6 +322,8 @@ def _moe_bench(args):
            "moe_at_or_below_dense": moe_final <= dense_final,
            "elapsed_s": round(time.perf_counter() - t0, 1),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")

@@ -246,6 +246,8 @@ def main():
     conv_case("conv3x3_fwd", fwd_only=True)
     conv_case("conv3x3_fwd_bwd")
 
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "pallas_conv_probe/v1")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)

@@ -359,6 +359,8 @@ def main():
             # budget reads (key: traffic.fused_total_bytes).
             "traffic": resnet_bn_traffic_bytes(args.traffic_batch),
         }
+        from chainermn_tpu.observability.ledger import stamp_envelope
+        stamp_envelope(doc, n_devices=jax.device_count())
         payload = json.dumps(doc, indent=2)
         if args.out:
             with open(args.out, "w") as f:

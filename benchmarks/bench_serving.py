@@ -468,6 +468,9 @@ def main():
         report["spec"] = run_spec(model, params, args)
     if args.replicas > 1:
         report["fleet"] = run_fleet(model, params, trace, args)
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(report, n_devices=report["devices"],
+                   backend=jax.default_backend())
     print(json.dumps(report, indent=1))
     if args.out:
         from chainermn_tpu.observability.sinks import atomic_write_json

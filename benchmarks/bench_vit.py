@@ -180,6 +180,8 @@ def main():
 
     out = retry_transient(lambda: run(args), attempts=args.attempts,
                           label="bench_vit")
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(out, "bench_vit/v1")
     print(json.dumps(out), flush=True)
 
 

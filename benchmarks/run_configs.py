@@ -690,6 +690,8 @@ def main():
 
     if args.tune_remat:
         doc = tune_remat(repeats=args.repeats)
+        from chainermn_tpu.observability.ledger import stamp_envelope
+        stamp_envelope(doc)
         payload = json.dumps(doc, indent=2)
         if args.out:
             with open(args.out, "w") as f:

@@ -22,6 +22,7 @@ nothing else still runs ``captured-constant`` / ``donation-alias`` /
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -100,7 +101,8 @@ class LintContext:
                  inter_size=None, plan=None, loss=None, loss_args=None,
                  donate_argnums=(), fsdp_meta=None, fsdp_state=None,
                  variants=None, census=False, hlo=True,
-                 max_const_bytes=DEFAULT_MAX_BYTES, flight_events=None):
+                 max_const_bytes=DEFAULT_MAX_BYTES, flight_events=None,
+                 artifact_root=None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs or {}
@@ -122,6 +124,7 @@ class LintContext:
         self.fsdp_state = fsdp_state
         self._variants_spec = variants
         self.flight_events = flight_events
+        self.artifact_root = artifact_root
         self.census = census
         self.hlo = hlo
         self.max_const_bytes = max_const_bytes
@@ -286,6 +289,41 @@ class LintContext:
                     for r, e in sorted(by_rank.items())}
         return self._memo("flight_spans", build)
 
+    @property
+    def artifact_census(self) -> Optional[List[dict]]:
+        """Every committed artifact under ``artifact_root``, parsed and
+        classified against the run-ledger schema registry — the
+        ``artifact-drift`` input.  One row per artifact: ``path``
+        (relative), ``doc``, ``classification`` (``None`` =
+        unknown schema), ``manifest`` (the ``run_manifest/v1`` record,
+        carrying device kind and modeled/measured link rates)."""
+        def build():
+            root = self.artifact_root
+            if not root:
+                self.unavailable["artifact_census"] = \
+                    "no artifact_root given (pass artifact_root=)"
+                return None
+            from chainermn_tpu.observability.ledger import (
+                build_manifest, classify_artifact, iter_artifacts)
+            rows: List[dict] = []
+            for path in iter_artifacts(root):
+                row = {"path": os.path.relpath(path, root)}
+                try:
+                    with open(path) as fh:
+                        doc = json.load(fh)
+                except Exception as e:  # noqa: BLE001 — itself a finding
+                    row["error"] = f"{type(e).__name__}: {e}"
+                    rows.append(row)
+                    continue
+                cls = classify_artifact(doc, path)
+                row["doc"] = doc
+                row["classification"] = cls
+                row["manifest"] = build_manifest(
+                    doc, path, root=root, classification=cls)
+                rows.append(row)
+            return rows
+        return self._memo("artifact_census", build)
+
 
 def allreduce_hlo(comm, nelems: int = 1024, dtype=jnp.float32,
                   plan=None) -> str:
@@ -360,7 +398,7 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
               fsdp_meta=None, fsdp_state=None, variants=None,
               census=False, hlo: bool = True,
               max_const_bytes: int = DEFAULT_MAX_BYTES,
-              flight_events=None,
+              flight_events=None, artifact_root=None,
               rules: Optional[Sequence[str]] = None,
               raise_on_error: bool = True, name: str = "",
               **kwargs) -> LintReport:
@@ -379,7 +417,8 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
                       fsdp_state=fsdp_state, variants=variants,
                       census=census, hlo=hlo,
                       max_const_bytes=max_const_bytes,
-                      flight_events=flight_events)
+                      flight_events=flight_events,
+                      artifact_root=artifact_root)
     report = LintReport(target=ctx.name)
     selected = [get_rule(r) for r in rules] if rules else all_rules()
     for rule in selected:

@@ -713,5 +713,118 @@ def _overlapping_collectives(ctx) -> List[Finding]:
     return out
 
 
-__all__ = ["CPU_WIRE_PROMOTIONS", "Finding", "NP_TO_HLO_DTYPE", "Rule",
-           "SEVERITIES", "all_rules", "expected_kinds", "get_rule", "rule"]
+# ---------------------------------------------------------------------------
+# artifact-drift — committed artifacts vs the run-ledger schema registry
+# ---------------------------------------------------------------------------
+
+#: modeled-vs-measured link-rate disagreement a committed artifact may
+#: carry before the rule flags its claims as priced on a stale wire
+DRIFT_TOLERANCE_X = 1.5
+
+
+@rule("artifact-drift", "warning",
+      "committed artifacts must carry a registered schema, the common "
+      "envelope, and modeled link rates consistent with the latest "
+      "measured rates for the same device kind",
+      requires=("artifact_census",))
+def _artifact_drift(ctx) -> List[Finding]:
+    """Three longitudinal invariants over the committed artifact set
+    (``artifact_root=``, or ``cmn_lint --artifacts``):
+
+    * **unknown schema** (error): an artifact the run-ledger registry
+      cannot classify — it would land outside every gate and trend;
+    * **missing envelope** (info, aggregated): artifacts predating the
+      common envelope (no ``schema``+``git_sha`` stamp) — historical
+      r01–r05 era files are expected here, NEW writers are not;
+    * **modeled-rate drift** (warning): an artifact whose modeled
+      ``link_gbps`` disagrees with the LATEST measured rates
+      (LinkObservations / contention report) recorded for the SAME
+      device kind by more than ``DRIFT_TOLERANCE_X`` — its gated claims
+      are priced on a wire the fleet no longer has.  Rates measured on
+      a different (or unknown) device kind never cross-contaminate.
+    """
+    census = ctx.artifact_census
+    tol = float(getattr(ctx, "drift_tolerance", None)
+                or DRIFT_TOLERANCE_X)
+    out: List[Finding] = []
+    legacy: List[str] = []
+    # newest measured rates per (device_kind, link)
+    measured: Dict[tuple, tuple] = {}   # (dk, link) -> (order, gbps, path)
+    for row in census:
+        man = row.get("manifest")
+        if not man or man.get("device_kind") is None:
+            continue
+        order = (man.get("round") or "", man.get("timestamp") or "")
+        for link, gbps in (man.get("link_gbps_measured") or {}).items():
+            key = (man["device_kind"], link)
+            if key not in measured or order >= measured[key][0]:
+                measured[key] = (order, float(gbps), row["path"])
+    for row in census:
+        if "error" in row:
+            out.append(Finding(
+                rule="", severity="error", message=(
+                    f"artifact {row['path']} is unreadable "
+                    f"({row['error']}): it can be neither gated nor "
+                    f"registered in the run ledger"),
+                details={"artifact": row["path"],
+                         "error": row["error"]}))
+            continue
+        cls = row.get("classification")
+        if cls is None:
+            doc = row.get("doc")
+            declared = doc.get("schema") \
+                if isinstance(doc, dict) else None
+            out.append(Finding(
+                rule="", severity="error", message=(
+                    f"artifact {row['path']} has "
+                    + (f"unregistered schema {declared!r}"
+                       if declared else "no recognizable schema")
+                    + " — register it in observability.ledger."
+                    "KNOWN_SCHEMAS (and stamp the writer with "
+                    "stamp_envelope) or the ledger, the gates, and the "
+                    "trend lanes all skip it silently"),
+                details={"artifact": row["path"],
+                         "declared_schema": declared}))
+            continue
+        if cls.get("legacy"):
+            legacy.append(row["path"])
+        man = row["manifest"]
+        dk = man.get("device_kind")
+        if dk is None:
+            continue
+        for link, modeled in (man.get("link_gbps_modeled")
+                              or {}).items():
+            hit = measured.get((dk, link))
+            if hit is None or modeled <= 0 or hit[1] <= 0:
+                continue
+            _order, meas, src = hit
+            ratio = max(modeled / meas, meas / modeled)
+            if ratio <= tol:
+                continue
+            out.append(_finding(
+                f"artifact {row['path']} models the {link} link at "
+                f"{modeled:g} GB/s but the latest measured rate for "
+                f"device kind {dk!r} is {meas:g} GB/s ({src}) — "
+                f"x{ratio:.2f} apart (tolerance x{tol:g}).  Every "
+                f"speedup this artifact gates is priced on a wire the "
+                f"fleet does not have; re-run the sweep or re-baseline "
+                f"via perf_gate --ledger.",
+                artifact=row["path"], link=link, device_kind=dk,
+                modeled_gbps=modeled, measured_gbps=meas,
+                measured_in=src, ratio=ratio, tolerance=tol))
+    if legacy:
+        out.append(Finding(
+            rule="", severity="info", message=(
+                f"{len(legacy)} committed artifact(s) predate the "
+                f"common envelope (no schema/git_sha stamp): "
+                f"{', '.join(legacy[:6])}"
+                + (" ..." if len(legacy) > 6 else "")
+                + ".  Historical artifacts stay as-is; new writers "
+                "must stamp via observability.ledger.stamp_envelope."),
+            details={"artifacts": legacy}))
+    return out
+
+
+__all__ = ["CPU_WIRE_PROMOTIONS", "DRIFT_TOLERANCE_X", "Finding",
+           "NP_TO_HLO_DTYPE", "Rule", "SEVERITIES", "all_rules",
+           "expected_kinds", "get_rule", "rule"]

@@ -93,6 +93,22 @@ from chainermn_tpu.observability.contention import (
 from chainermn_tpu.observability.streaming import (
     TelemetryAggregator,
 )
+from chainermn_tpu.observability.ledger import (
+    RunLedger,
+    build_manifest,
+    classify_artifact,
+    ingest_artifacts,
+    iter_artifacts,
+    stamp_envelope,
+)
+from chainermn_tpu.observability.diffing import (
+    diff_histograms,
+    diff_manifests,
+    diff_profiles,
+    diff_runs,
+    load_run,
+    run_profile,
+)
 from chainermn_tpu.observability.watchdog import (
     Watchdog,
     WatchdogConfig,
@@ -110,6 +126,7 @@ __all__ = [
     "InstrumentedCommunicator",
     "MetricsRegistry",
     "PlanObs",
+    "RunLedger",
     "Span",
     "StepTelemetry",
     "StragglerDetector",
@@ -122,10 +139,16 @@ __all__ = [
     "attribute_step",
     "attribution_consistency",
     "attribution_report",
+    "build_manifest",
     "build_step_trees",
+    "classify_artifact",
     "clock_handshake",
     "contention_report",
     "critical_path",
+    "diff_histograms",
+    "diff_manifests",
+    "diff_profiles",
+    "diff_runs",
     "disable",
     "enable",
     "enabled",
@@ -134,10 +157,13 @@ __all__ = [
     "get_plan_obs",
     "get_registry",
     "identify_desync",
+    "ingest_artifacts",
     "install_flight_recorder",
     "instrument_communicator",
+    "iter_artifacts",
     "leaf_comm_spans",
     "link_rates",
+    "load_run",
     "merge_ranks",
     "occupancy_from_events",
     "occupancy_timelines",
@@ -147,9 +173,11 @@ __all__ = [
     "prometheus_text",
     "read_jsonl",
     "reset_flight_recorder",
+    "run_profile",
     "span_link",
     "span_owner",
     "span_summary",
+    "stamp_envelope",
     "start_watchdog",
     "straggler_report",
     "summarize_durations",

@@ -61,7 +61,8 @@ def test_multichip_day1_dry_run():
                  "cmn-lint static preflight", "perf gate",
                  "collective-planner autotune gate",
                  "step-time attribution smoke",
-                 "span-tracing overhead A/B"):
+                 "span-tracing overhead A/B",
+                 "run-ledger leg"):
         assert step in out, f"runbook lost its '{step}' step:\n{out}"
     assert out.count("DRY_RUN: not executed") >= 9, out
     assert "artifact:" in out

@@ -191,6 +191,8 @@ def main(argv=None) -> int:
         "n_trace_events": len(trace.get("traceEvents", [])),
         "worker_results": {str(r): results[r] for r in sorted(results)},
     }
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "attribution_smoke/v1", n_devices=len(dumps))
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)
 

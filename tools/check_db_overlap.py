@@ -96,6 +96,8 @@ def main():
                           "psum with the loss psum; overlap defeated — "
                           "investigate")
         ok = split
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "db_overlap_check/v1")
     print(json.dumps(doc), flush=True)
     if args.out:
         with open(args.out, "w") as f:

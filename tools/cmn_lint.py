@@ -65,6 +65,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         "runs the dynamic rules (default: "
                         "overlapping-collectives) over the spans "
                         "rebuilt from the recording")
+    p.add_argument("--artifacts", metavar="ROOT", default=None,
+                   help="lint COMMITTED artifacts instead of an entry "
+                        "point: walk ROOT for *_r*.json / BENCH_*.json "
+                        "and run the longitudinal rules (default: "
+                        "artifact-drift — unknown schemas, missing "
+                        "envelopes, modeled link rates that disagree "
+                        "with the latest measured rates per device "
+                        "kind); combinable with --events")
     p.add_argument("--list", action="store_true", dest="list_entries",
                    help="list entry points and rules, then exit")
     return p
@@ -93,20 +101,32 @@ def _load_events(path: str) -> dict:
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
 
-    if args.events:
+    if args.events or args.artifacts:
         from chainermn_tpu.analysis.lint import lint_step
-        rules = args.rules.split(",") if args.rules \
-            else ["overlapping-collectives"]
-        rep = lint_step(None, flight_events=_load_events(args.events),
+        if args.rules:
+            rules = args.rules.split(",")
+        else:
+            rules = ([] if not args.events
+                     else ["overlapping-collectives"]) \
+                + ([] if not args.artifacts else ["artifact-drift"])
+        entry = ":".join(filter(None, [
+            f"events:{args.events}" if args.events else None,
+            f"artifacts:{args.artifacts}" if args.artifacts else None]))
+        rep = lint_step(None,
+                        flight_events=(_load_events(args.events)
+                                       if args.events else None),
+                        artifact_root=args.artifacts,
                         rules=rules, hlo=False, raise_on_error=False,
-                        name=f"events:{args.events}")
+                        name=entry)
         doc = {
             "suite": "cmn_lint",
-            "entry": f"events:{args.events}",
+            "entry": entry,
             "ok": rep.ok,
             "findings": [f.as_dict() for f in rep.findings],
             "reports": [rep.to_json()],
         }
+        from chainermn_tpu.observability.ledger import stamp_envelope
+        stamp_envelope(doc, "cmn_lint/v1")
         if args.out:
             os.makedirs(os.path.dirname(os.path.abspath(args.out)),
                         exist_ok=True)
@@ -162,6 +182,8 @@ def main(argv=None) -> int:
         "findings": findings,
         "reports": [rep.to_json() for rep in reports],
     }
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "cmn_lint/v1")
     if args.out:
         os.makedirs(os.path.dirname(os.path.abspath(args.out)),
                     exist_ok=True)

@@ -343,6 +343,8 @@ def main(argv=None) -> int:
         "report": rep,
         "worker_results": {str(r): results[r] for r in sorted(results)},
     }
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "contention_smoke/v1", n_devices=len(dumps))
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)
 

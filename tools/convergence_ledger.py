@@ -186,6 +186,8 @@ def main():
         print(f"convergence: {name}: {doc['checks'][name]}",
               file=sys.stderr, flush=True)
     doc["ok"] = not failed
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "convergence_ledger/v1")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)

@@ -314,6 +314,25 @@ run 0 "$OUT/ONLINE_TUNE_$ROUND.json" \
         && $PY_TPU tools/perf_gate.py \
             --online-tune '$OUT/ONLINE_TUNE_$ROUND.json'"
 
+# ---- run ledger: backfill -> regression diff -> ledger gate -----------
+# Cross-run observatory (docs/observability.md "Run ledger & regression
+# diffing"): register every committed artifact as a run_manifest/v1
+# record (zero unknown-schema entries is the bar), replay the committed
+# degraded-DCN dump against its healthy twin — the run_diff/v1 must
+# localize the regression to the dcn_comm bucket — then gate today's
+# artifacts against per-(device_kind, schema) ledger baselines, so a
+# TPU day is held to TPU history and never to a CPU-host rerun.
+run 0 "$OUT/LEDGER_$ROUND.json" \
+    "run-ledger leg: backfill-ingest committed artifacts (no unknown schemas), replay healthy-vs-degraded diff (must name dcn_comm), then perf_gate --ledger per-(device_kind, schema) baselines" -- \
+    bash -c "$PY_TPU tools/ledger.py ingest --root '$REPO' \
+            --out '$OUT/LEDGER_$ROUND.json' > /dev/null \
+        && $PY_TPU tools/ledger.py diff \
+            tests/data/healthy_dcn_spans.json \
+            tests/data/degraded_dcn_spans.json \
+            --out '$OUT/REGRESSION_DIFF_$ROUND.json' > /dev/null \
+        && $PY_TPU tools/perf_gate.py --ledger '$OUT/LEDGER_$ROUND.json' \
+            --out '$OUT/LEDGER_GATE_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

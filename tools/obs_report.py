@@ -1007,6 +1007,107 @@ def lint_section(doc: dict) -> str:
     return head + "\n" + _table(["sev", "rule", "target", "finding"], rows)
 
 
+# ---------------------------------------------------------------------------
+# --ledger / --diff: the longitudinal lanes (run ledger & regression diff)
+# ---------------------------------------------------------------------------
+
+def ledger_section(path: str) -> str:
+    """Run-ledger lane: every registered run, one row per
+    ``run_manifest/v1`` record, plus the (device_kind, schema)
+    baseline-selection grid ``perf_gate --ledger`` picks baselines
+    from.  ``path`` is the ledger JSONL or a committed ``run_ledger/v1``
+    snapshot (LEDGER_r*.json)."""
+    from chainermn_tpu.observability.ledger import RunLedger
+    ledger = RunLedger.load(path)
+    records = ledger.records()
+    head = (f"run ledger ({path}: {len(records)} record(s), "
+            f"{len(ledger.cells())} (device_kind, schema) cell(s))")
+    if not records:
+        return head + "\nledger is empty — run tools/ledger.py ingest"
+    rows = []
+    for r in sorted(records, key=RunLedger._order):
+        metrics = r.get("metrics") or {}
+        headline = ", ".join(f"{k}={v:g}" for k, v in
+                             sorted(metrics.items())[:2]) or "-"
+        rows.append([
+            r.get("round") or "-",
+            r.get("artifact_schema") or "?",
+            r.get("device_kind") or "?",
+            str(r.get("n_devices") or "-"),
+            (r.get("git_sha") or "")[:8] or "-",
+            "legacy" if r.get("legacy_envelope") else "stamped",
+            headline,
+        ])
+    return head + "\n" + _table(
+        ["round", "schema", "device", "ndev", "sha", "envelope",
+         "headline metrics"], rows)
+
+
+def diff_section(path: str) -> str:
+    """Regression-diff lane: render a ``run_diff/v1`` document
+    (tools/ledger.py diff) — the bucket drift table and the localized
+    regression with its link/stage evidence."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != "run_diff/v1":
+        return f"{path} is not a run_diff/v1 document"
+    base = doc.get("baseline", {})
+    cand = doc.get("candidate", {})
+    head = (f"run diff ({base.get('label') or base.get('artifact')} -> "
+            f"{cand.get('label') or cand.get('artifact')})")
+    parts = [head]
+    bucket_rows = [
+        [r["bucket"], _fmt_s(r["base_s"]), _fmt_s(r["cand_s"]),
+         f"{r['delta_s'] * 1e3:+.3f} ms",
+         f"x{r['ratio']:.2f}" if r.get("ratio") else "-"]
+        for r in doc.get("buckets", [])
+        if r.get("base_s") or r.get("cand_s")]
+    if bucket_rows:
+        parts.append(_table(
+            ["bucket", "baseline", "candidate", "delta", "ratio"],
+            bucket_rows))
+    metric_rows = [
+        [r["metric"], f"{r.get('base', '-')}", f"{r.get('cand', '-')}",
+         f"x{r['ratio']:.3f}" if r.get("ratio") else "-"]
+        for r in doc.get("metrics", [])]
+    if metric_rows:
+        parts.append(_table(["metric", "baseline", "candidate", "ratio"],
+                            metric_rows))
+    for name, row in sorted((doc.get("histograms") or {}).items()):
+        if row.get("grid_mismatch"):
+            parts.append(f"histogram {name}: grid mismatch — "
+                         f"quantile deltas not comparable")
+            continue
+        qs = ", ".join(
+            f"{q} {_fmt_s(v.get('a'))} -> {_fmt_s(v.get('b'))}"
+            for q, v in sorted(row.items()) if isinstance(v, dict))
+        parts.append(f"histogram {name}: {qs}")
+    reg = doc.get("regression")
+    if reg:
+        ev = reg.get("evidence") or {}
+        stage = ev.get("stage") or {}
+        lines = [f"REGRESSED: {reg['bucket']} "
+                 f"+{reg['delta_s'] * 1e3:.3f} ms "
+                 f"(x{reg['ratio']:.2f}, "
+                 f"confidence {reg['confidence']:.2f})"]
+        if ev.get("link"):
+            lines.append(f"  link: {ev['link']}")
+        if stage:
+            lines.append(
+                f"  worst stage: {stage.get('stage')} "
+                f"{_fmt_s(stage.get('base_mean_s'))} -> "
+                f"{_fmt_s(stage.get('cand_mean_s'))} mean"
+                + (f", {stage.get('base_gbps'):.2f} -> "
+                   f"{stage.get('cand_gbps'):.2f} GB/s"
+                   if stage.get("base_gbps") and stage.get("cand_gbps")
+                   else ""))
+        parts.append("\n".join(lines))
+    else:
+        parts.append("no bucket regressed past the floors — runs are "
+                     "equivalent at this resolution")
+    return "\n\n".join(parts)
+
+
 def _live_loop(path: str, names: List[str], interval: float = 2.0) -> int:
     """``--live``: tail-follow the metrics JSONL and re-render the
     selected sections whenever the file grows (the streaming aggregator
@@ -1086,7 +1187,26 @@ def main(argv=None) -> int:
                          "cmn_lint.py --out; a directory is globbed for "
                          "CMN_LINT_*.json) — alone, or as the static-"
                          "analysis lane after the --flight report")
+    ap.add_argument("--ledger", metavar="PATH", default=None,
+                    help="render the run ledger (tools/ledger.py "
+                         "ingest: a ledger JSONL or a run_ledger/v1 "
+                         "snapshot like LEDGER_r17.json) — every "
+                         "registered run and the (device_kind, schema) "
+                         "baseline grid")
+    ap.add_argument("--diff", metavar="PATH", default=None,
+                    help="render a run_diff/v1 document (tools/"
+                         "ledger.py diff): bucket drift and the "
+                         "localized regression")
     args = ap.parse_args(argv)
+
+    if args.ledger or args.diff:
+        parts = []
+        if args.ledger:
+            parts.append(ledger_section(args.ledger))
+        if args.diff:
+            parts.append(diff_section(args.diff))
+        print("\n\n".join(parts))
+        return 0
 
     lint_out = None
     if args.lint:

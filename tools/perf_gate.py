@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """perf_gate — fail loudly when a tracked benchmark regresses.
 
-Four modes, all exit nonzero on a gate failure so the runbook/CI leg
+Six modes, all exit nonzero on a gate failure so the runbook/CI leg
 that invokes them goes red instead of silently recording a slower repo:
 
 1. Budget check (default)::
@@ -75,9 +75,23 @@ that invokes them goes red instead of silently recording a slower repo:
    ``autotune_from_rows`` only knows all-reduce names, so this mode
    computes its own tuned-vs-flat comparison.)
 
+6. Ledger gate::
+
+       python tools/perf_gate.py --ledger LEDGER.json
+
+   Budget check with LONGITUDINAL baselines: for every tracked metric
+   the baseline is selected from the run ledger's records for the same
+   ``(device_kind, artifact schema)`` cell — the best prior value that
+   substrate has actually produced — falling back to the static budget
+   floor when the cell has no prior record.  This is the re-baselining
+   seam ROADMAP item 5 needs: a v5 TPU artifact is never compared
+   against a CPU-host floor, and vice versa.  Writes a
+   ``ledger_gate/v1`` artifact.
+
 Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER,
-ONLINE_TUNE, SERVING_FLEET and PLANNER_GATE_ALLTOALL legs; see
-docs/collective_planner.md, docs/moe.md and docs/serving.md.
+ONLINE_TUNE, SERVING_FLEET, PLANNER_GATE_ALLTOALL and LEDGER legs; see
+docs/collective_planner.md, docs/moe.md, docs/serving.md and
+docs/observability.md (Run ledger & regression diffing).
 """
 
 import argparse
@@ -94,6 +108,7 @@ ONLINE_TUNE_SCHEMA = "online_tune/v1"
 SERVING_SCHEMA = "bench_serving/v2"
 MOE_GATE_SCHEMA = "moe_gate/v1"
 MOE_BENCH_SCHEMA = "moe_bench/v1"
+LEDGER_GATE_SCHEMA = "ledger_gate/v1"
 FLAT_ALLTOALL = "alltoall_flat"
 
 
@@ -538,6 +553,127 @@ def moe_gate(args):
     return 0 if ok else 1
 
 
+def ledger_gate(args):
+    """Budget check with per-(device_kind, schema) baselines from the
+    run ledger.  For each tracked metric the newest matching artifact
+    is classified; its baseline is the best prior value among ledger
+    records sharing BOTH its artifact schema and its device kind
+    (``baseline_source: "ledger"``), so a CPU-host rerun is held to CPU
+    history and a future TPU run re-baselines against TPU history.  A
+    cell with no prior record falls back to the static budget floor
+    (``baseline_source: "budget"``)."""
+    from chainermn_tpu.observability.ledger import (
+        _METRIC_PATHS, RunLedger, build_manifest, stamp_envelope)
+
+    ledger = RunLedger.load(args.ledger)
+    floors_path = args.floors or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf_budgets.json")
+    with open(floors_path) as f:
+        budgets = json.load(f)
+    if budgets.get("schema") != BUDGETS_SCHEMA:
+        print(f"perf_gate: unsupported budgets schema "
+              f"{budgets.get('schema')!r} (want {BUDGETS_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    max_reg = float(args.max_regression_pct
+                    if args.max_regression_pct is not None
+                    else budgets.get("max_regression_pct", 3.0))
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rows = []
+    failed = 0
+    for m in budgets.get("metrics", []):
+        matches = sorted(glob.glob(os.path.join(root, m["artifact"])),
+                         key=os.path.getmtime)
+        direction = m.get("direction", "higher")
+        row = {"name": m["name"], "artifact": m["artifact"],
+               "unit": m.get("unit"), "budget": float(m["budget"]),
+               "direction": direction}
+        if not matches:
+            row["status"] = "missing"
+            if args.strict:
+                failed += 1
+            rows.append(row)
+            print(f"perf_gate {row['status']:>9} {row['name']}",
+                  file=sys.stderr)
+            continue
+        path = matches[-1]
+        row["path"] = os.path.relpath(path, root)
+        try:
+            doc = json.load(open(path))
+            value = _dig(doc, m["key"])
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            row["status"] = f"unreadable: {e}"
+            failed += 1
+            rows.append(row)
+            continue
+        row["value"] = value
+        manifest = build_manifest(doc, path, root=root)
+        schema = manifest["artifact_schema"]
+        dk = manifest["device_kind"]
+        row["artifact_schema"] = schema
+        row["device_kind"] = dk
+        # the ledger metric whose extraction path IS this budget's key
+        ledger_metric = next(
+            (name for name, dotted in
+             _METRIC_PATHS.get(schema or "", {}).items()
+             if dotted == m["key"]), None)
+        prior = [r for r in ledger.records(schema)
+                 if r.get("device_kind") == dk
+                 and ledger_metric in r.get("metrics", {})
+                 and r.get("artifact") != row["path"]
+                 and not r.get("noise_dominated")] \
+            if ledger_metric else []
+        if prior:
+            pick = (max if direction == "higher" else min)
+            base_rec = pick(prior,
+                            key=lambda r: r["metrics"][ledger_metric])
+            baseline = base_rec["metrics"][ledger_metric]
+            row["baseline_source"] = "ledger"
+            row["baseline_artifact"] = base_rec.get("artifact")
+            row["baseline_round"] = base_rec.get("round")
+        else:
+            baseline = row["budget"]
+            row["baseline_source"] = "budget"
+        row["baseline"] = baseline
+        denom = abs(baseline) or 1.0
+        reg = ((value - baseline) if direction == "lower"
+               else (baseline - value)) / denom * 100.0
+        row["regression_pct"] = round(reg, 2)
+        if reg > max_reg:
+            row["status"] = "FAIL"
+            failed += 1
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+        print(f"perf_gate {row['status']:>9} {row['name']} "
+              f"[{dk or '?'}/{schema or '?'}]: value={value} "
+              f"baseline={baseline} ({row['baseline_source']}"
+              + (f" {row.get('baseline_round')}"
+                 if row.get('baseline_round') else "")
+              + f") {row['regression_pct']}% vs {max_reg}% allowed",
+              file=sys.stderr)
+    report = stamp_envelope({
+        "schema": LEDGER_GATE_SCHEMA,
+        "ledger": os.path.basename(args.ledger),
+        "floors": floors_path,
+        "max_regression_pct": max_reg,
+        "root": root,
+        "metrics": rows,
+        "ok": failed == 0,
+    })
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    n_ledger = sum(1 for r in rows
+                   if r.get("baseline_source") == "ledger")
+    print(json.dumps({"ok": report["ok"], "failed": failed,
+                      "checked": len(rows),
+                      "ledger_baselines": n_ledger}), flush=True)
+    return 0 if failed == 0 else 1
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--budgets", default=None, metavar="BUDGETS.json",
@@ -602,14 +738,21 @@ def main():
                         metavar="X",
                         help="MoE mode: minimum bf16-DCN byte shrink at "
                              "the largest swept payload (default 1.8)")
+    parser.add_argument("--ledger", default=None, metavar="LEDGER.json",
+                        help="ledger-gate mode: run-ledger JSONL or "
+                             "run_ledger/v1 snapshot; budget metrics are "
+                             "held to the best prior value of the same "
+                             "(device_kind, schema) cell instead of only "
+                             "the static floor")
     parser.add_argument("--out", default=None, metavar="OUT.json",
                         help="write the gate report/artifact JSON here")
     args = parser.parse_args()
     modes = [bool(args.budgets), bool(args.planner),
-             bool(args.online_tune), bool(args.serving), bool(args.moe)]
+             bool(args.online_tune), bool(args.serving), bool(args.moe),
+             bool(args.ledger)]
     if sum(modes) != 1:
         parser.error("pass exactly one of --budgets, --planner, "
-                     "--online-tune, --serving, or --moe")
+                     "--online-tune, --serving, --moe, or --ledger")
     if args.planner:
         return planner_gate(args)
     if args.online_tune:
@@ -618,6 +761,8 @@ def main():
         return serving_gate(args)
     if args.moe:
         return moe_gate(args)
+    if args.ledger:
+        return ledger_gate(args)
     return check_budgets(args)
 
 

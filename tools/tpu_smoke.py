@@ -477,6 +477,8 @@ def main():
     doc["ok"] = bool(doc["checks"]) and all(
         c.get("ok") for c in doc["checks"].values())
 
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "tpu_smoke/v1")
     blob = json.dumps(doc)
     if args.out:
         with open(args.out, "w") as f:
