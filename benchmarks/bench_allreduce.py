@@ -266,23 +266,30 @@ def main():
 
 
 def _parse_link_gbps(spec):
-    """``"ici=100,dcn=0.5"`` -> ``{"ici": 100.0, "dcn": 0.5}``.  Only
-    the two link classes the cost model prices are accepted; a missing
-    class is treated as free (infinite bandwidth) downstream."""
+    """``"ici=100,dcn=0.5"`` -> ``{"ici": 100.0, "dcn": 0.5}``.  Keys
+    are validated against the cost model's ``LINK_CLASS`` values
+    (``planner.compiler.validate_link_gbps``) so a typo'd class
+    (``icn=0.2``) fails loudly, naming the accepted classes, instead of
+    being priced as a free link downstream; a genuinely missing class
+    is still treated as free (infinite bandwidth)."""
+    from chainermn_tpu.planner.compiler import validate_link_gbps
+
     out = {}
     for part in str(spec).split(","):
         if not part.strip():
             continue
         name, sep, val = part.partition("=")
-        name = name.strip()
-        if not sep or name not in ("ici", "dcn"):
+        if not sep:
             raise ValueError(
                 f"--link-gbps expects ici=X,dcn=Y (GB/s), got {spec!r}")
-        out[name] = float(val)
+        out[name.strip()] = float(val)
     if not out:
         raise ValueError(
             f"--link-gbps expects ici=X,dcn=Y (GB/s), got {spec!r}")
-    return out
+    try:
+        return validate_link_gbps(out)
+    except ValueError as e:
+        raise ValueError(f"--link-gbps: {e}") from None
 
 
 def _time_spmd(comm, body, stacked, iters, warmup):

@@ -1,0 +1,135 @@
+"""Joint-vs-independent plan tuning under the shared-link workload
+model — the global scheduler's committed proof (``JOINT_SWEEP_r18``).
+
+Device-free and deterministic: builds the two-slot step workload the
+contention observatory measured overlapping (the bucketed-FSDP gradient
+allreduce and the MoE dispatch/combine all-to-all) on one topology,
+tunes each slot independently (today's per-communicator path:
+``plan_modeled_time_s`` argmin over its candidate zoo), tunes them
+jointly (``planner.schedule.jointly_tune`` — coordinate descent under
+the fair-share link simulator), and records both workload makespans.
+The joint pick must beat independent by the ``joint_schedule_speedup``
+budget (>=1.05x) AND differ in at least one slot — the ceded-link
+behavior, e.g. the striped allreduce giving up its DCN stripe while
+the MoE exchange owns that wire (``tools/perf_gate.py --joint`` gates
+both; the ``JOINT_SCHEDULE`` leg of ``tools/multichip_day1.sh`` runs
+the pair).
+
+Usage::
+
+    python benchmarks/bench_joint.py \
+        --topology inter:2,intra:4 --link-gbps ici=0.2,dcn=0.02 \
+        --allreduce-kib 4096 --moe-kib 8192 --out JOINT_SWEEP_r18.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Runnable from a fresh clone without `pip install -e .`.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+JOINT_SWEEP_SCHEMA = "joint_sweep/v1"
+
+
+def run_joint_sweep(topology_key, link_gbps, allreduce_bytes, moe_bytes,
+                    dtype="float32", stripe_ratios=None):
+    """The modeled sweep: returns the ``joint_sweep/v1`` document body
+    (no envelope).  Pure function of its arguments — the committed
+    artifact reproduces from the CLI flags it records."""
+    from chainermn_tpu.planner.ir import PlanTopology
+    from chainermn_tpu.planner.plans import (STRIPE_RATIOS, alltoall_plans,
+                                             candidate_plans)
+    from chainermn_tpu.planner.schedule import (StepWorkload, WorkloadSlot,
+                                                jointly_tune,
+                                                simulate_workload)
+
+    topology = PlanTopology.from_key(topology_key)
+    ratios = STRIPE_RATIOS if stripe_ratios is None else tuple(stripe_ratios)
+    workload = StepWorkload(topology=topology, slots=(
+        WorkloadSlot(name="allreduce", nbytes=int(allreduce_bytes),
+                     dtype=dtype, op="all-reduce"),
+        WorkloadSlot(name="moe", nbytes=int(moe_bytes),
+                     dtype=dtype, op="all-to-all"),
+    ))
+    candidates = {
+        "allreduce": candidate_plans(topology, stripe_ratios=ratios),
+        "moe": alltoall_plans(topology),
+    }
+    table, cmp = jointly_tune(workload, candidates, link_gbps)
+    joint_plans = table.entries[cmp["signature"]]
+    sched = simulate_workload(workload.with_plans(joint_plans), link_gbps)
+    occupancy = {
+        f"{link}/{owner}": {k: round(v, 9) for k, v in cell.items()}
+        for (link, owner), cell in sorted(sched.occupancy.items())}
+    return {
+        "schema": JOINT_SWEEP_SCHEMA,
+        "kind": "joint_sweep",
+        "modeled": True,
+        "topology": topology.key(),
+        "dtype": dtype,
+        "link_gbps": {k: float(v) for k, v in sorted(link_gbps.items())},
+        "workload": workload.to_dict(),
+        "signature": cmp["signature"],
+        "n_candidates": {name: len(zoo)
+                         for name, zoo in sorted(candidates.items())},
+        "comparison": cmp,
+        "joint_occupancy": occupancy,
+        "joint_link_busy_s": dict(sorted(sched.link_busy_s.items())),
+        "joint_table": table.to_dict(),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--topology", default="inter:2,intra:4",
+                        help="planner topology key (default matches the "
+                        "8-device CPU-mesh runbook legs)")
+    parser.add_argument("--link-gbps", default="ici=0.2,dcn=0.02",
+                        help="heterogeneous link rates, ici=X,dcn=Y in "
+                        "GB/s (validated against LINK_CLASS values)")
+    parser.add_argument("--allreduce-kib", type=int, default=4096,
+                        help="packed gradient allreduce payload (KiB)")
+    parser.add_argument("--moe-kib", type=int, default=8192,
+                        help="MoE exchange block payload (KiB)")
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--stripe-ratios", default=None,
+                        help="comma-separated striped-candidate ratios "
+                        "(default: the stock STRIPE_RATIOS ladder)")
+    parser.add_argument("--out", default=None,
+                        help="write the joint_sweep/v1 artifact here "
+                        "(default: stdout)")
+    args = parser.parse_args()
+
+    from benchmarks.bench_allreduce import _parse_link_gbps
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    from chainermn_tpu.planner.ir import PlanTopology
+
+    link_gbps = _parse_link_gbps(args.link_gbps)
+    ratios = None if args.stripe_ratios is None else [
+        float(r) for r in str(args.stripe_ratios).split(",") if r.strip()]
+    doc = run_joint_sweep(args.topology, link_gbps,
+                          args.allreduce_kib << 10, args.moe_kib << 10,
+                          dtype=args.dtype, stripe_ratios=ratios)
+    doc["timestamp"] = time.time()
+    stamp_envelope(doc, n_devices=PlanTopology.from_key(args.topology).size,
+                   backend="modeled")
+    blob = json.dumps(doc, indent=2) + "\n"
+    cmp = doc["comparison"]
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob)
+        ind_s = cmp["independent"]["modeled_s"]
+        print(f"joint sweep: independent {ind_s:.6f}s -> joint "
+              f"{cmp['joint']['modeled_s']:.6f}s "
+              f"({cmp['speedup']:.4f}x, changed "
+              f"{cmp['changed_slots']}) -> {args.out}", file=sys.stderr)
+    else:
+        print(blob, end="")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -662,9 +662,14 @@ def _overlapping_collectives(ctx) -> List[Finding]:
     Each independently-tuned plan prices the link at full bandwidth, so
     when two of them actually run concurrently both deliver below their
     modeled GB/s — the contention blind spot ROADMAP item 4 names.
-    Spans sharing one identity are one co-tuned decision (a striped
-    plan's concurrent groups split the link on purpose) and are never
-    flagged.  Full nesting counts: one identity's span time-containing
+    Spans sharing one identity are one co-tuned decision and are never
+    flagged: a striped plan's concurrent groups split the link on
+    purpose, and plans co-tuned in one ``StepWorkload``
+    (``planner.schedule.jointly_tune``) carry the shared workload
+    signature in their ``@wl:``-tagged names, which ``plan_identity``
+    folds to one ``workload:<sig>`` identity — the joint scheduler's
+    deliberate cross-communicator overlap is priced by the fair-share
+    simulator, not a blind spot.  Full nesting counts: one identity's span time-containing
     another's IS overlap (the worst case — the inner transfer runs
     entirely under contention); only a true wrapper-over-decomposition
     pair (``leaf_comm_spans``) is exempt.  Severity is ``warning``:

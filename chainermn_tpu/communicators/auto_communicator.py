@@ -75,6 +75,7 @@ class AutoCommunicator(MeshCommunicator):
 
     def _allreduce_grad_traced(self, grads):
         from chainermn_tpu.planner.compiler import execute_plan
+        from chainermn_tpu.planner.schedule import register_plan_slot
         leaves = jax.tree.leaves(grads)
         nbytes = sum(int(np.prod(jnp.shape(l)) or 1)
                      * jnp.dtype(l.dtype).itemsize for l in leaves)
@@ -86,4 +87,12 @@ class AutoCommunicator(MeshCommunicator):
                 int(np.prod(jnp.shape(l)) or 1) * jnp.dtype(l.dtype).itemsize
         dtype = max(by_dtype, key=lambda k: by_dtype[k]) if by_dtype \
             else "float32"
+        # announce the in-flight gradient allreduce to the global
+        # scheduler (trace time — shapes are static), so a joint retune
+        # can re-price it against whatever else shares the links; its
+        # compiled plan stages show up in occupancy timelines under
+        # "plan:<scope>" (or "fsdp"/"collective" on pre-planner paths)
+        register_plan_slot("allreduce", nbytes=nbytes, dtype=dtype,
+                           op="all-reduce",
+                           owners=("plan:", "fsdp", "collective"))
         return execute_plan(self.plan_for(nbytes, dtype), self, grads)

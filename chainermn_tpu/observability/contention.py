@@ -119,15 +119,30 @@ def span_owner(span: Span) -> Optional[str]:
     return None
 
 
+#: the workload tag a jointly-tuned plan name carries
+#: (``<base>@wl:<signature>`` — written by ``planner.schedule.tag_plan``;
+#: the literal is duplicated here so observability does not import the
+#: planner, and ``tests/test_planner.py`` pins the two in sync)
+_WORKLOAD_TAG = "@wl:"
+
+
 def plan_identity(span: Span) -> Optional[str]:
     """Tuning identity of a comm span — spans sharing an identity were
     tuned TOGETHER (a striped plan's concurrent groups share a plan
-    name: their ratio split is one co-tuned decision), spans with
-    different identities were tuned independently.  The
-    ``overlapping-collectives`` lint keys on this."""
+    name: their ratio split is one co-tuned decision; plans co-tuned in
+    one ``StepWorkload`` share the workload signature their ``@wl:``
+    name tag carries), spans with different identities were tuned
+    independently.  The ``overlapping-collectives`` lint keys on this,
+    so a joint schedule's deliberate cross-communicator overlap is
+    exempt exactly like one striped plan's concurrent groups."""
     if span.kind == "plan_stage":
         plan = span.meta.get("plan")
-        return f"plan:{plan}" if plan is not None else "plan:?"
+        if plan is not None:
+            _base, sep, sig = str(plan).partition(_WORKLOAD_TAG)
+            if sep and sig:
+                return f"workload:{sig}"
+            return f"plan:{plan}"
+        return "plan:?"
     if span.kind == "fsdp":
         return "fsdp"
     if span.kind == "collective":

@@ -72,6 +72,9 @@ KNOWN_SCHEMAS = {
     "fleet_telemetry/v1",
     "contention/v1",
     "contention_smoke/v1",
+    "joint_sweep/v1",
+    "joint_plan_table/v1",
+    "step_workload/v1",
     "attribution_smoke/v1",
     "bench_headline/v1",
     "cmn_lint/v1",
@@ -134,6 +137,8 @@ _METRIC_PATHS: Dict[str, Dict[str, str]] = {
     "bench_vit/v1": {"vit_throughput": "official.value"},
     "bench_lm/v1": {"lm_throughput": "official.value"},
     "remat_tune/v1": {"fused_norm_speedup": "fused_norm.speedup"},
+    "joint_sweep/v1": {
+        "joint_schedule_speedup": "comparison.speedup"},
 }
 
 

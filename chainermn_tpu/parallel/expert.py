@@ -134,8 +134,19 @@ def moe_apply(expert_fn: Callable, gate_logits, x, axis_name,
             b, axis_name, split_axis=0, concat_axis=0, tiled=True)
     else:
         from chainermn_tpu.planner.compiler import execute_alltoall
+        from chainermn_tpu.planner.schedule import (register_plan_slot,
+                                                    resolve_slot_plan)
         topo = (plan_topology if plan_topology is not None
                 else moe_plan_topology(axis_name))
+        # global-scheduler seam (trace time): announce the exchange
+        # payload as the "moe" plan slot and honor a jointly-tuned
+        # override when the online tuner installed one — the dispatch
+        # and combine exchanges are one slot (same buffer both ways)
+        register_plan_slot(
+            "moe", nbytes=e * c * d * jnp.dtype(x.dtype).itemsize,
+            dtype=jnp.dtype(x.dtype).name, op="all-to-all",
+            owners=("moe",))
+        plan = resolve_slot_plan("moe", plan)
         exchange = lambda b: execute_alltoall(plan, topo, b, pobs=plan_obs)
     recv = exchange(send.reshape(p, epd * c, d))
     recv = recv.reshape(p, epd, c, d).transpose(1, 0, 2, 3)  # [E/P, P, C, D]

@@ -8,6 +8,13 @@ seven legacy flavors as fixed plans; :class:`PlanTable` +
 :func:`autotune_from_rows` select per-message-size plans from
 ``bench_allreduce --sweep`` data for ``create_communicator("auto")``.
 
+The global scheduler (ROADMAP item 4) lifts the cost model from one
+plan to the SET of plans in flight per step: :class:`StepWorkload` +
+:func:`workload_modeled_time_s` price concurrent plans under fair link
+sharing, :func:`jointly_tune` picks every slot's plan together, and
+:class:`JointPlanTable` carries the decision keyed by workload
+signature (``planner/schedule.py``).
+
 See docs/collective_planner.md.
 """
 
@@ -35,6 +42,32 @@ from chainermn_tpu.planner.compiler import (
     plan_stage_lengths,
     plan_wire_bytes,
     plan_wire_dtypes,
+    validate_link_gbps,
+)
+from chainermn_tpu.planner.schedule import (
+    JOINT_TABLE_SCHEMA,
+    JointPlanTable,
+    StepWorkload,
+    WORKLOAD_SCHEMA,
+    WORKLOAD_TAG,
+    WorkloadSchedule,
+    WorkloadSlot,
+    clear_plan_slots,
+    default_candidates,
+    derated_link_gbps,
+    get_slot_plan,
+    independent_plans,
+    jointly_tune,
+    plan_workload_signature,
+    reconstruct_workload,
+    register_plan_slot,
+    registered_slots,
+    resolve_slot_plan,
+    set_slot_plan,
+    simulate_workload,
+    tag_plan,
+    untagged_plan_name,
+    workload_modeled_time_s,
 )
 from chainermn_tpu.planner.online import (
     LinkObservations,
@@ -73,6 +106,8 @@ __all__ = [
     "BUCKET_EDGES",
     "FIXED_PLAN_NAMES",
     "FLAVOR_NAMES",
+    "JOINT_TABLE_SCHEMA",
+    "JointPlanTable",
     "LINK_CLASS",
     "LinkObservations",
     "ONLINE_TUNE_SCHEMA",
@@ -88,17 +123,28 @@ __all__ = [
     "SWEEP_SCHEMA",
     "Stage",
     "StageGroup",
+    "StepWorkload",
+    "WORKLOAD_SCHEMA",
+    "WORKLOAD_TAG",
+    "WorkloadSchedule",
+    "WorkloadSlot",
     "active_plan_table_meta",
     "alltoall_plans",
     "autotune_from_rows",
     "broadcast_plans",
     "clear_active_plan_table",
+    "clear_plan_slots",
     "candidate_plans",
+    "default_candidates",
+    "derated_link_gbps",
     "execute_alltoall",
     "execute_plan",
     "flavor_plan",
     "get_active_plan_table",
+    "get_slot_plan",
+    "independent_plans",
     "init_plan_compression_states",
+    "jointly_tune",
     "load_plan",
     "multicast_plan",
     "plan_census_kinds",
@@ -111,10 +157,21 @@ __all__ = [
     "plan_wire_bytes",
     "plan_table_hash",
     "plan_wire_dtypes",
+    "plan_workload_signature",
     "recommend_prefetch_depth",
+    "reconstruct_workload",
+    "register_plan_slot",
+    "registered_slots",
+    "resolve_slot_plan",
     "set_active_plan_table",
+    "set_slot_plan",
+    "simulate_workload",
     "size_bucket",
     "striped_plan",
     "synthesize_sweep_rows",
+    "tag_plan",
+    "untagged_plan_name",
+    "validate_link_gbps",
     "validate_sweep_rows",
+    "workload_modeled_time_s",
 ]

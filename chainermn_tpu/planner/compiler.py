@@ -870,6 +870,33 @@ def plan_wire_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
 LINK_CLASS = {"intra": "ici", "inter": "dcn", "all": "dcn"}
 
 
+def validate_link_gbps(link_gbps: Dict[str, float]) -> Dict[str, float]:
+    """Validate a ``{link class: GB/s}`` mapping against the known
+    :data:`LINK_CLASS` values and return it normalized to float rates.
+
+    A typo'd key (``icn`` for ``ici``) would otherwise be SILENT: the
+    cost model reads links via ``link_gbps.get(link)`` and prices a
+    missing class as free, so the misspelled rate never constrains
+    anything and every plan looks equally fast on that wire.  Fail
+    loudly instead, naming the accepted classes — ``bench_allreduce``
+    / ``bench_moe`` ``--link-gbps`` parsing and every modeled-time
+    entry point route through this."""
+    accepted = sorted(set(LINK_CLASS.values()))
+    unknown = sorted(set(str(k) for k in link_gbps) - set(accepted))
+    if unknown:
+        raise ValueError(
+            f"unknown link class(es) {unknown} in link rates; accepted "
+            f"names are {accepted} (the LINK_CLASS values)")
+    out = {}
+    for link, bw in link_gbps.items():
+        bw = float(bw)
+        if bw < 0:
+            raise ValueError(
+                f"link class {link!r} has negative bandwidth {bw}")
+        out[str(link)] = bw
+    return out
+
+
 def plan_link_bytes(plan: Plan, topology: PlanTopology, nbytes: int,
                     dtype="float32") -> dict:
     """Per-(scope, link-class) wire bytes of ``plan`` moving ``nbytes``
@@ -906,8 +933,13 @@ def plan_modeled_time_s(plan: Plan, topology: PlanTopology, nbytes: int,
 
     A plain single-chain plan degenerates to its chain sum (which
     dominates any one link's share).
+
+    ``link_gbps`` keys are validated against :data:`LINK_CLASS` values
+    (:func:`validate_link_gbps`) — an unknown class would silently
+    price as free.
     """
     item = np.dtype(dtype).itemsize
+    link_gbps = validate_link_gbps(link_gbps)
 
     def _rate(link: str) -> float:
         bw = link_gbps.get(link)
@@ -944,4 +976,5 @@ __all__ = ["LINK_CLASS", "execute_alltoall", "execute_plan",
            "init_plan_compression_states",
            "plan_census_kinds", "plan_compressed_hops", "plan_dcn_bytes",
            "plan_group_lengths", "plan_link_bytes", "plan_modeled_time_s",
-           "plan_stage_lengths", "plan_wire_bytes", "plan_wire_dtypes"]
+           "plan_stage_lengths", "plan_wire_bytes", "plan_wire_dtypes",
+           "validate_link_gbps"]

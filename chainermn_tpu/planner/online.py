@@ -268,7 +268,8 @@ class OnlineTuner:
                  registry=None, window: int = 256, min_samples: int = 2,
                  threshold: float = 1.05,
                  stripe_ratios: Tuple[float, ...] = STRIPE_RATIOS,
-                 fallback_gbps: Optional[Dict[str, float]] = None):
+                 fallback_gbps: Optional[Dict[str, float]] = None,
+                 joint: bool = False):
         from chainermn_tpu.observability import flight_recorder as _flight
         from chainermn_tpu.observability import registry as _registry
 
@@ -289,6 +290,12 @@ class OnlineTuner:
         self.min_samples = int(min_samples)
         self.stripe_ratios = tuple(stripe_ratios)
         self.fallback_gbps = dict(fallback_gbps or {})
+        #: joint mode (ROADMAP item 4): re-price the whole in-flight
+        #: StepWorkload — reconstructed from registered plan slots +
+        #: contention occupancy timelines — instead of this
+        #: communicator's plans alone, and swap every slot atomically
+        self.joint = bool(joint)
+        self._timelines: Optional[dict] = None
         self.observations = LinkObservations(window=window)
         self._flight = flight if flight is not None \
             else _flight.get_flight_recorder()
@@ -343,6 +350,14 @@ class OnlineTuner:
             stall = float(attribution.get("buckets", {}).get("stall", 0.0))
             self._stall_fracs.append(stall / step_s)
 
+    def observe_timelines(self, timelines: dict) -> None:
+        """Bank the latest contention occupancy timelines
+        (:func:`~chainermn_tpu.observability.contention.
+        occupancy_timelines` / ``occupancy_from_events`` output) — the
+        evidence the joint retune uses to reconstruct WHICH registered
+        plan slots are actually in flight."""
+        self._timelines = timelines
+
     def on_regression(self, flagged: List[dict]) -> bool:
         """The AttributionWatch trigger seam: arm a re-tune when a comm
         bucket regressed.  Returns whether this call armed it."""
@@ -366,10 +381,23 @@ class OnlineTuner:
         current observation window: synthesized sweep rows under the
         observed link rates, through ``autotune_from_rows``, with the
         modeled old-vs-new speedup per cell.  ``None`` when there is
-        nothing to price (no observed traffic, no link rates)."""
+        nothing to price (no observed traffic, no link rates).
+
+        In joint mode (``joint=True``) the decision is computed over
+        the whole in-flight :class:`~chainermn_tpu.planner.schedule.
+        StepWorkload` instead — reconstructed from the registered plan
+        slots filtered by the banked contention occupancy timelines —
+        and re-priced under the shared-link fair-share simulator at the
+        observed (contention-derated, when fed through
+        ``feed_link_observations``) rates; it falls back to the
+        per-plan path when fewer than two slots are in flight."""
         gbps = dict(self.fallback_gbps)
         gbps.update(link_gbps if link_gbps is not None
                     else self.observations.observed_gbps(self.min_samples))
+        if self.joint and gbps:
+            decision = self._retune_joint(gbps)
+            if decision is not None:
+                return decision
         if not gbps or not self._payload_max:
             return None
         rows: List[dict] = []
@@ -428,6 +456,98 @@ class OnlineTuner:
                 table_hash=decision["table_hash"])
         return decision
 
+    def _retune_joint(self, gbps: Dict[str, float]) -> Optional[dict]:
+        """The joint decision: rebuild the in-flight workload from the
+        plan-slot registry (filtered by banked occupancy timelines),
+        jointly tune every slot under the shared-link simulator at the
+        observed rates, and package the result so the EXISTING swap
+        machinery applies it atomically — all-reduce slots ride the
+        plan-table swap (rank-0 broadcast + sidecar hash untouched),
+        other slots ride ``joint.slot_plans`` which
+        :meth:`apply_decision` installs into the schedule registry in
+        the same step-boundary apply.  ``None`` when fewer than two
+        slots are in flight (the per-plan path then runs)."""
+        from chainermn_tpu.planner import schedule as _sched
+
+        workload = _sched.reconstruct_workload(
+            self.topology, timelines=self._timelines)
+        if workload is None or len(workload.slots) < 2:
+            return None
+        old_s = None
+        old_plans = {}
+        for slot in workload.slots:
+            if slot.op == "all-reduce":
+                old_plans[slot.name] = (
+                    self.table.lookup(self.topology, slot.dtype,
+                                      slot.nbytes) or flavor_plan("flat"))
+            else:
+                old_plans[slot.name] = _sched.get_slot_plan(slot.name)
+        if all(p is not None for p in old_plans.values()):
+            old_s = _sched.workload_modeled_time_s(
+                workload.with_plans(old_plans), gbps)
+        jtable, cmp = _sched.jointly_tune(
+            workload, link_gbps=gbps, stripe_ratios=self.stripe_ratios)
+        sig = cmp["signature"]
+        tagged = jtable.entries[sig]
+        new_table = PlanTable(meta=dict(self.table.meta,
+                                        joint_signature=sig))
+        new_table.entries.update(self.table.entries)
+        slot_plans = {}
+        for slot in workload.slots:
+            plan = tagged[slot.name]
+            if slot.op == "all-reduce":
+                new_table.put(self.topology, slot.dtype,
+                              size_bucket(slot.nbytes), plan)
+            else:
+                slot_plans[slot.name] = plan.to_dict()
+        joint_s = cmp["joint"]["modeled_s"]
+        # the swap criterion: modeled win of the joint pick over the
+        # CURRENTLY-INSTALLED plans when all are known, else over the
+        # independently-tuned baseline
+        base_s = old_s if old_s is not None \
+            else cmp["independent"]["modeled_s"]
+        best_speedup = (base_s / joint_s) if joint_s > 0 else 1.0
+        cells = [{
+            "topology": self.topology.key(), "dtype": row["dtype"],
+            "slot": row["slot"], "bucket": size_bucket(int(row["nbytes"])),
+            "bytes": int(row["nbytes"]),
+            "old_plan": getattr(old_plans.get(row["slot"]), "name", None),
+            "independent_plan": row["independent_plan"],
+            "new_plan": row["joint_plan"], "changed": row["changed"],
+        } for row in cmp["slots"]]
+        decision = {
+            "schema": ONLINE_TUNE_SCHEMA,
+            "kind": "plan_table_swap",
+            "mode": "joint",
+            "step": None,
+            "table": new_table.to_dict(),
+            "table_hash": plan_table_hash(new_table),
+            "observed_gbps": {k: float(v) for k, v in sorted(gbps.items())},
+            "cells": cells,
+            "best_speedup": best_speedup,
+            "threshold": self.threshold,
+            "swap": best_speedup >= self.threshold,
+            "evidence": list(self._evidence),
+            "joint": {
+                "signature": sig,
+                "slot_plans": slot_plans,
+                "speedup_vs_independent": cmp["speedup"],
+                "changed_slots": cmp["changed_slots"],
+                "comparison": cmp,
+            },
+        }
+        self.last_decision = decision
+        if self._reg is not None:
+            self._retunes_total.inc(1)
+            self._speedup_gauge.set(float(best_speedup))
+        if self._flight is not None:
+            self._flight.record(
+                "plan_table_retune", best_speedup=best_speedup,
+                swap=decision["swap"], n_cells=len(cells),
+                table_hash=decision["table_hash"], mode="joint",
+                workload_signature=sig)
+        return decision
+
     # -- the step-boundary hot-swap ---------------------------------------
     def maybe_swap(self, step: int) -> Optional[dict]:
         """COLLECTIVE when the world has multiple controllers: every
@@ -466,6 +586,23 @@ class OnlineTuner:
         self.table = new_table
         set_active_plan_table(new_table, step=int(step),
                               evidence=decision.get("evidence"))
+        joint = decision.get("joint")
+        if joint:
+            # the atomic multi-slot half of a joint swap: non-table
+            # slots (e.g. the MoE exchange) flip via the schedule
+            # registry in the SAME apply — every controller runs this
+            # with the same broadcast decision, so all slots of all
+            # controllers land on this step boundary together
+            from chainermn_tpu.planner import schedule as _sched
+            for slot_name, spec in sorted(
+                    joint.get("slot_plans", {}).items()):
+                _sched.set_slot_plan(slot_name, Plan.from_dict(spec))
+            if self._flight is not None:
+                self._flight.record(
+                    "workload_swap", step=int(step),
+                    workload_signature=joint.get("signature"),
+                    changed_slots=joint.get("changed_slots"),
+                    slots=sorted(joint.get("slot_plans", {})))
         if self._flight is not None:
             self._flight.record(
                 "plan_table_swap", step=int(step),

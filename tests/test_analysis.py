@@ -619,6 +619,59 @@ def test_rule_overlapping_collectives_ignores_cotuned_stripes():
     assert rep.ok and rep.findings == []
 
 
+def test_rule_overlapping_collectives_exempts_cotuned_workload():
+    """Two DIFFERENT plans whose names carry the same ``@wl:<sig>``
+    workload tag were priced together by the global scheduler
+    (planner.schedule.jointly_tune) — their overlap is the joint plan,
+    not accidental contention, so the rule must not fire."""
+    events = (
+        _flight("plan_stage_begin", "plan_stage_end", 40.000, 40.030,
+                plan="striped_r90@wl:ab12cd34ef56", op="all-reduce",
+                stage=0, scope="intra", link="ici", nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 40.010, 40.040,
+                  plan="alltoall_hier@wl:ab12cd34ef56", op="all_to_all",
+                  stage=0, scope="intra", link="ici", nbytes=1 << 18))
+    for i, e in enumerate(events):
+        e["seq"] = i
+    rep = lint_step(None, flight_events={0: events},
+                    rules=["overlapping-collectives"], hlo=False,
+                    raise_on_error=False, name="synthetic")
+    assert rep.ok and rep.findings == [], rep.findings
+
+
+def test_rule_overlapping_collectives_fires_across_workloads():
+    """Broken fixture: the same two plans overlapping WITHOUT a shared
+    workload signature (different tags, or one untagged) are still
+    independently tuned — the exemption must not swallow them."""
+    different_sig = (
+        _flight("plan_stage_begin", "plan_stage_end", 41.000, 41.030,
+                plan="striped_r90@wl:ab12cd34ef56", op="all-reduce",
+                stage=0, scope="intra", link="ici", nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 41.010, 41.040,
+                  plan="alltoall_hier@wl:999999999999", op="all_to_all",
+                  stage=0, scope="intra", link="ici", nbytes=1 << 18))
+    one_untagged = (
+        _flight("plan_stage_begin", "plan_stage_end", 42.000, 42.030,
+                plan="striped_r90@wl:ab12cd34ef56", op="all-reduce",
+                stage=0, scope="intra", link="ici", nbytes=1 << 20)
+        + _flight("plan_stage_begin", "plan_stage_end", 42.010, 42.040,
+                  plan="alltoall_hier", op="all_to_all", stage=0,
+                  scope="intra", link="ici", nbytes=1 << 18))
+    for events, identities in (
+            (different_sig, ["workload:999999999999",
+                             "workload:ab12cd34ef56"]),
+            (one_untagged, ["plan:alltoall_hier",
+                            "workload:ab12cd34ef56"])):
+        for i, e in enumerate(events):
+            e["seq"] = i
+        rep = lint_step(None, flight_events={0: events},
+                        rules=["overlapping-collectives"], hlo=False,
+                        raise_on_error=False, name="synthetic")
+        assert [f.rule for f in rep.findings] == \
+            ["overlapping-collectives"]
+        assert sorted(rep.findings[0].details["identities"]) == identities
+
+
 def test_rule_overlapping_collectives_skips_without_events(devices):
     rep = lint_step(lambda x: x * 2, jnp.ones((4,)), hlo=False,
                     raise_on_error=False)

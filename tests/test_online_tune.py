@@ -701,3 +701,119 @@ def test_two_controllers_swap_on_the_same_step():
     assert results[0]["pin"]["swap_step"] == 11
     assert results[0]["plan_8mib"] == results[1]["plan_8mib"] != "flat"
     assert results[0]["best_speedup"] >= 1.05
+
+
+# ---------------------------------------------------------------------------
+# the joint (whole-workload) retune path
+# ---------------------------------------------------------------------------
+
+from chainermn_tpu.observability.contention import feed_link_observations  # noqa: E402
+from chainermn_tpu.planner import plan_modeled_time_s  # noqa: E402
+from chainermn_tpu.planner.schedule import (  # noqa: E402
+    clear_plan_slots,
+    get_slot_plan,
+    plan_workload_signature,
+    register_plan_slot,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_slots():
+    """The plan-slot registry is module-global process state."""
+    clear_plan_slots()
+    yield
+    clear_plan_slots()
+
+
+class TestDeratedObservationPricing:
+    def test_feed_link_observations_beats_fallback_in_retune(self):
+        """Regression for the observed-rate path: contention-derated
+        samples pushed through feed_link_observations must WIN over
+        fallback_gbps in retune() pricing — the tuner prices the link
+        at what it delivers UNDER measured overlap, and the cell's
+        old-plan price is exactly plan_modeled_time_s at that rate."""
+        tuner = OnlineTuner(topology=TOPO_2D, min_samples=1,
+                            fallback_gbps={"ici": 16.0, "dcn": 2.0})
+        events, _ = _stage_pair(0.0, "hierarchical", 0, "ici", 1 << 20,
+                                16.0)
+        tuner.ingest(events)
+        # PR 16 link_rates shape: the dcn link delivered 0.05 GB/s
+        # effective under overlap (a 40x derate vs the 2.0 fallback)
+        derated = {"dcn": {"bytes": 8 << 20,
+                           "busy_s": (8 << 20) / 0.05e9,
+                           "derate": 0.025}}
+        feed_link_observations(tuner.observations, derated)
+        d = tuner.retune()
+        assert d is not None
+        assert d["observed_gbps"]["dcn"] == pytest.approx(0.05, rel=1e-6)
+        priced = {"ici": d["observed_gbps"]["ici"], "dcn": 0.05}
+        cell = next(c for c in d["cells"] if c["bytes"] == 1 << 20)
+        assert cell["old_modeled_s"] == pytest.approx(
+            plan_modeled_time_s(flavor_plan("flat"), TOPO_2D, 1 << 20,
+                                priced), rel=1e-9)
+
+    def test_zero_byte_rates_are_ignored(self):
+        obs = LinkObservations()
+        feed_link_observations(obs, {"dcn": {"bytes": 0, "busy_s": 1.0},
+                                     "ici": {"busy_s": 0.0}})
+        assert obs.observed_gbps(1) == {}
+
+
+class TestJointRetune:
+    def _register_two_slots(self):
+        register_plan_slot("allreduce", nbytes=4 << 20, op="all-reduce",
+                           owners=("plan:", "fsdp", "collective"))
+        register_plan_slot("moe", nbytes=8 << 20, op="all-to-all",
+                           owners=("moe",))
+
+    def test_joint_decision_and_atomic_apply(self):
+        """joint=True retune over two registered slots yields a
+        mode="joint" decision; apply_decision installs the non-table
+        slot's plan through the schedule registry in the SAME apply as
+        the table swap, both tagged with the workload signature."""
+        self._register_two_slots()
+        fr = FlightRecorder(capacity=256)
+        tuner = OnlineTuner(topology=TOPO_2D, min_samples=1, joint=True,
+                            flight=fr,
+                            fallback_gbps={"ici": 0.2, "dcn": 0.02})
+        d = tuner.retune()
+        assert d is not None and d.get("mode") == "joint"
+        joint = d["joint"]
+        assert joint["speedup_vs_independent"] >= 1.05
+        assert joint["changed_slots"]
+        assert set(joint["slot_plans"]) == {"moe"}
+        assert d["swap"] is True
+        assert d["table_hash"] == plan_table_hash(
+            PlanTable.from_dict(d["table"]))
+
+        tuner.apply_decision(d, step=7)
+        live = get_slot_plan("moe")
+        assert live is not None
+        assert plan_workload_signature(live.name) == joint["signature"]
+        ar = tuner.table.lookup(TOPO_2D, "float32", 4 << 20)
+        assert ar is not None
+        assert plan_workload_signature(ar.name) == joint["signature"]
+        kinds = [e["kind"] for e in fr.events_since(-1)]
+        assert "workload_swap" in kinds
+        assert "plan_table_swap" in kinds
+        ws = next(e for e in fr.events_since(-1)
+                  if e["kind"] == "workload_swap")
+        assert ws["workload_signature"] == joint["signature"]
+        assert ws["step"] == 7
+
+    def test_timeline_evidence_gates_the_joint_path(self):
+        """Occupancy timelines showing only ONE registered slot's owner
+        leave fewer than two slots in flight — the joint path declines
+        and the tuner stays on its per-plan path."""
+        self._register_two_slots()
+        tuner = OnlineTuner(topology=TOPO_2D, min_samples=1, joint=True,
+                            fallback_gbps={"ici": 0.2, "dcn": 0.02})
+        tuner.observe_timelines({"ici": {"fsdp": [(0.0, 1.0)]}})
+        assert tuner.retune() is None  # no per-plan payloads observed
+
+    def test_joint_mode_off_by_default(self):
+        self._register_two_slots()
+        tuner = OnlineTuner(topology=TOPO_2D, min_samples=1,
+                            fallback_gbps={"ici": 0.2, "dcn": 0.02})
+        d = tuner.retune()
+        assert d is None or d.get("mode") != "joint"

@@ -828,3 +828,322 @@ class TestPerfGateCLI:
         r2 = _run_gate(["--planner", sweep])
         assert r2.returncode == 0, r2.stderr[-2000:]
         assert json.loads(r2.stdout.splitlines()[-1])["tuned_wins"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# global scheduler: workload IR, fair-share simulator, joint tuning
+# ---------------------------------------------------------------------------
+
+from chainermn_tpu.observability import contention as _contention  # noqa: E402
+from chainermn_tpu.planner import (  # noqa: E402
+    JointPlanTable,
+    StepWorkload,
+    WORKLOAD_TAG,
+    WorkloadSlot,
+    alltoall_plans,
+    jointly_tune,
+    plan_modeled_time_s,
+    plan_workload_signature,
+    simulate_workload,
+    striped_plan,
+    tag_plan,
+    untagged_plan_name,
+    validate_link_gbps,
+    workload_modeled_time_s,
+)
+
+GBPS = {"ici": 0.2, "dcn": 0.02}
+
+
+def _ar_slot(nbytes=4 << 20, plan=None, **kw):
+    return WorkloadSlot(name="allreduce", nbytes=nbytes, op="all-reduce",
+                        plan=plan or flavor_plan("hierarchical"), **kw)
+
+
+def _moe_slot(nbytes=8 << 20, plan=None, **kw):
+    if plan is None:
+        plan = next(p for p in alltoall_plans(TOPO_2D)
+                    if p.name == "alltoall_hierarchical")
+    return WorkloadSlot(name="moe", nbytes=nbytes, op="all-to-all",
+                        plan=plan, **kw)
+
+
+class TestWorkloadIR:
+    def test_roundtrip(self, tmp_path):
+        wl = StepWorkload(topology=TOPO_2D, slots=(
+            _ar_slot(), _moe_slot(after=("allreduce",))))
+        wl2 = StepWorkload.from_json(wl.to_json())
+        assert wl2 == wl
+        path = str(tmp_path / "wl.json")
+        wl.save(path)
+        assert StepWorkload.load(path) == wl
+        assert wl.slot("moe").after == ("allreduce",)
+
+    def test_validation(self):
+        with pytest.raises(PlanError, match="duplicate"):
+            StepWorkload(topology=TOPO_2D,
+                         slots=(_ar_slot(), _ar_slot()))
+        with pytest.raises(PlanError, match="unknown slot"):
+            StepWorkload(topology=TOPO_2D,
+                         slots=(_ar_slot(after=("ghost",)),))
+        with pytest.raises(PlanError, match="cycle"):
+            StepWorkload(topology=TOPO_2D, slots=(
+                _ar_slot(after=("moe",)), _moe_slot(after=("allreduce",))))
+        with pytest.raises(PlanError, match="nbytes"):
+            WorkloadSlot(name="x", nbytes=0)
+
+    def test_signature_excludes_plan_choices(self):
+        """The signature keys the workload SHAPE: same shape with
+        different (or no) plan assignments recalls the same joint
+        decision; changing a payload across a bucket edge does not."""
+        wl = StepWorkload(topology=TOPO_2D, slots=(_ar_slot(), _moe_slot()))
+        replanned = wl.with_plans({"allreduce": flavor_plan("flat")})
+        bare = StepWorkload(topology=TOPO_2D, slots=(
+            WorkloadSlot(name="allreduce", nbytes=4 << 20, plan=None),
+            WorkloadSlot(name="moe", nbytes=8 << 20, op="all-to-all")))
+        assert wl.signature() == replanned.signature() == bare.signature()
+        other = StepWorkload(topology=TOPO_2D, slots=(
+            _ar_slot(nbytes=64 << 20), _moe_slot()))
+        assert other.signature() != wl.signature()
+        # payload jitter within one size bucket recalls the same entry
+        jitter = StepWorkload(topology=TOPO_2D, slots=(
+            _ar_slot(nbytes=(4 << 20) + 8), _moe_slot()))
+        assert size_bucket((4 << 20) + 8) == size_bucket(4 << 20)
+        assert jitter.signature() == wl.signature()
+
+    def test_tag_literal_pinned_with_contention_lint(self):
+        """planner.schedule and observability.contention each hold the
+        `@wl:` literal (observability must not import the planner) —
+        this pins the two copies together, and pins the lint-side parse
+        to the planner-side tagger."""
+        assert WORKLOAD_TAG == _contention._WORKLOAD_TAG == "@wl:"
+        tagged = tag_plan(flavor_plan("hierarchical"), "abc123def456")
+        assert tagged.name == "hierarchical@wl:abc123def456"
+        assert untagged_plan_name(tagged.name) == "hierarchical"
+        assert plan_workload_signature(tagged.name) == "abc123def456"
+        assert plan_workload_signature("hierarchical") is None
+        span = SimpleNamespace(kind="plan_stage",
+                               meta={"plan": tagged.name})
+        assert _contention.plan_identity(span) == "workload:abc123def456"
+
+    def test_link_gbps_validation_is_loud(self):
+        """A typo'd link class used to be priced as FREE by the cost
+        model (`link_gbps.get` miss) — now every modeled-time entry
+        point raises, naming the accepted classes."""
+        with pytest.raises(ValueError, match=r"icn.*dcn.*ici"):
+            validate_link_gbps({"icn": 0.2, "dcn": 0.02})
+        with pytest.raises(ValueError, match="negative"):
+            validate_link_gbps({"ici": -0.5})
+        assert validate_link_gbps({"ici": 1}) == {"ici": 1.0}
+        with pytest.raises(ValueError, match="accepted"):
+            plan_modeled_time_s(flavor_plan("hierarchical"), TOPO_2D,
+                                1 << 20, {"icl": 0.2})
+        with pytest.raises(ValueError, match="accepted"):
+            workload_modeled_time_s(
+                StepWorkload(topology=TOPO_2D, slots=(_ar_slot(),)),
+                {"pcie": 1.0})
+
+
+class TestWorkloadSimulator:
+    def test_single_slot_reduces_to_plan_modeled_time(self):
+        """A one-slot workload is bit-exact (==, not approx) with the
+        existing single-plan price for every plan in the zoo — the
+        simulator strictly generalizes plan_modeled_time_s."""
+        zoo = candidate_plans(TOPO_2D, stripe_ratios=(0.5,)) + \
+            alltoall_plans(TOPO_2D)
+        assert len(zoo) > 8
+        for plan in zoo:
+            op = "all-to-all" if plan.name.startswith("alltoall") else \
+                "all-reduce"
+            wl = StepWorkload(topology=TOPO_2D, slots=(
+                WorkloadSlot(name="only", nbytes=4 << 20, op=op,
+                             plan=plan),))
+            solo = plan_modeled_time_s(plan, TOPO_2D, 4 << 20, GBPS)
+            assert workload_modeled_time_s(wl, GBPS) == solo, plan.name
+
+    def test_conservation_per_link(self):
+        """Per link, owner fair shares sum to the link's union busy
+        seconds — no modeled bandwidth is created or destroyed by
+        splitting it."""
+        wl = StepWorkload(topology=TOPO_2D, slots=(
+            _ar_slot(plan=striped_plan(0.5)), _moe_slot()))
+        sched = simulate_workload(wl, GBPS)
+        assert sched.contended_slots  # the fixture does contend
+        for link, union in sched.link_busy_s.items():
+            shares = sum(cell["share_s"]
+                         for (l, _o), cell in sched.occupancy.items()
+                         if l == link)
+            assert shares == pytest.approx(union, rel=1e-9), link
+            # and wall busy_s per owner never exceeds the union
+            for (l, o), cell in sched.occupancy.items():
+                if l == link:
+                    assert cell["busy_s"] <= union + 1e-12
+
+    def test_monotonicity_adding_a_slot(self):
+        """Adding a plan to the workload never finishes an existing
+        slot EARLIER (fair sharing only takes bandwidth away)."""
+        for ar_plan in (flavor_plan("hierarchical"), striped_plan(0.5),
+                        flavor_plan("two_dimensional")):
+            solo_wl = StepWorkload(topology=TOPO_2D,
+                                   slots=(_ar_slot(plan=ar_plan),))
+            both_wl = StepWorkload(topology=TOPO_2D,
+                                   slots=(_ar_slot(plan=ar_plan),
+                                          _moe_slot()))
+            alone = simulate_workload(solo_wl, GBPS)
+            both = simulate_workload(both_wl, GBPS)
+            assert both.finish_s["allreduce"] + 1e-12 >= \
+                alone.finish_s["allreduce"], ar_plan.name
+            assert both.makespan_s + 1e-12 >= alone.makespan_s
+
+    def test_ordering_constraint_serializes(self):
+        """`after` slots start at their predecessor's finish — and a
+        serialized pair never contends, so both finish at exactly their
+        solo prices, back to back."""
+        wl = StepWorkload(topology=TOPO_2D, slots=(
+            _ar_slot(), _moe_slot(after=("allreduce",))))
+        sched = simulate_workload(wl, GBPS)
+        assert sched.contended_slots == ()
+        assert sched.start_s["moe"] == sched.finish_s["allreduce"]
+        assert sched.finish_s["allreduce"] == \
+            sched.slot_solo_s["allreduce"]
+        assert sched.makespan_s == (sched.slot_solo_s["allreduce"]
+                                    + sched.slot_solo_s["moe"])
+
+    def test_derate_slows_the_workload(self):
+        wl = StepWorkload(topology=TOPO_2D, slots=(_ar_slot(),))
+        base = workload_modeled_time_s(wl, GBPS)
+        derated = workload_modeled_time_s(wl, GBPS,
+                                          derate={"ici": 0.5, "dcn": 0.5})
+        assert derated == pytest.approx(base * 2.0, rel=1e-9)
+
+
+class TestJointTuning:
+    def _workload(self):
+        return StepWorkload(topology=TOPO_2D, slots=(
+            WorkloadSlot(name="allreduce", nbytes=4 << 20,
+                         op="all-reduce"),
+            WorkloadSlot(name="moe", nbytes=8 << 20, op="all-to-all")))
+
+    def _candidates(self):
+        from chainermn_tpu.planner.plans import STRIPE_RATIOS
+        return {"allreduce": candidate_plans(
+                    TOPO_2D, stripe_ratios=STRIPE_RATIOS),
+                "moe": alltoall_plans(TOPO_2D)}
+
+    def test_joint_beats_independent_with_a_ceded_slot(self):
+        """The committed-gate configuration: joint tuning must beat
+        independent by >=1.05x AND change a slot — the striped
+        allreduce cedes its DCN stripe while the MoE exchange owns
+        that wire."""
+        table, cmp = jointly_tune(self._workload(), self._candidates(),
+                                  GBPS)
+        assert cmp["speedup"] >= 1.05
+        assert cmp["changed_slots"]
+        assert cmp["joint"]["modeled_s"] <= cmp["independent"]["modeled_s"]
+        sig = cmp["signature"]
+        plans = table.lookup(sig)
+        assert set(plans) == {"allreduce", "moe"}
+        for name, plan in plans.items():
+            assert plan_workload_signature(plan.name) == sig
+            assert untagged_plan_name(plan.name) == \
+                cmp["joint"]["plans"][name]
+
+    def test_joint_never_worse_than_independent(self):
+        """Descent is seeded from the independent picks, so the joint
+        makespan can never exceed the independent one — across payload
+        scales, including ones with no joint win to find."""
+        for ar_kib, moe_kib in ((64, 64), (1024, 4096), (16384, 256)):
+            wl = StepWorkload(topology=TOPO_2D, slots=(
+                WorkloadSlot(name="allreduce", nbytes=ar_kib << 10),
+                WorkloadSlot(name="moe", nbytes=moe_kib << 10,
+                             op="all-to-all")))
+            _t, cmp = jointly_tune(wl, self._candidates(), GBPS)
+            assert cmp["joint"]["modeled_s"] <= \
+                cmp["independent"]["modeled_s"] + 1e-15
+            assert cmp["speedup"] >= 1.0 - 1e-12
+
+    def test_joint_table_degrades_to_plan_table(self, tmp_path):
+        """slot_plan: the joint entry answers for the tuned signature;
+        an unknown workload falls through to the per-plan PlanTable
+        (and to None without one)."""
+        wl = self._workload()
+        table, cmp = jointly_tune(wl, self._candidates(), GBPS)
+        joint = table.slot_plan(wl, "allreduce")
+        assert joint is not None
+        assert plan_workload_signature(joint.name) == cmp["signature"]
+
+        unknown = StepWorkload(topology=TOPO_2D, slots=(
+            WorkloadSlot(name="allreduce", nbytes=64 << 20),))
+        assert table.slot_plan(unknown, "allreduce") is None
+        fallback = PlanTable()
+        fallback.put(TOPO_2D, "float32", size_bucket(64 << 20),
+                     flavor_plan("two_dimensional"))
+        via_table = table.slot_plan(unknown, "allreduce",
+                                    fallback=fallback)
+        assert via_table.name == "two_dimensional"
+
+        path = str(tmp_path / "joint.json")
+        table.save(path)
+        loaded = JointPlanTable.load(path)
+        assert loaded.lookup(cmp["signature"]).keys() == \
+            table.lookup(cmp["signature"]).keys()
+
+
+class TestJointGateCLI:
+    def test_committed_joint_sweep_passes_the_gate(self, tmp_path):
+        """The committed r18 joint sweep clears `perf_gate --joint`
+        through the same CLI the runbook's JOINT_SCHEDULE leg drives,
+        and the report records the ceded slot."""
+        art = os.path.join(REPO, "JOINT_SWEEP_r18.json")
+        out = tmp_path / "gate.json"
+        r = _run_gate(["--joint", art, "--out", str(out)])
+        assert r.returncode == 0, r.stderr[-2000:]
+        summary = json.loads(r.stdout.splitlines()[-1])
+        assert summary["ok"] is True
+        assert summary["speedup"] >= 1.05
+        assert summary["changed_slots"]
+        report = json.loads(out.read_text())
+        assert report["ok"] and report["signature"]
+
+    def test_gate_fails_without_a_ceded_slot(self, tmp_path):
+        """A joint sweep whose winner is the independent pick (no
+        changed slot) fails even above threshold, and a sub-threshold
+        speedup fails naming the number."""
+        with open(os.path.join(REPO, "JOINT_SWEEP_r18.json")) as f:
+            doc = json.load(f)
+        doc["comparison"]["changed_slots"] = []
+        art = tmp_path / "unchanged.json"
+        art.write_text(json.dumps(doc))
+        r = _run_gate(["--joint", str(art)])
+        assert r.returncode == 1
+        assert "changed_slots" in r.stderr
+
+        doc["comparison"]["changed_slots"] = ["allreduce"]
+        doc["comparison"]["speedup"] = 1.01
+        art.write_text(json.dumps(doc))
+        r2 = _run_gate(["--joint", str(art)])
+        assert r2.returncode == 1
+        assert "1.0100" in r2.stderr
+
+    def test_gate_rejects_wrong_schema(self, tmp_path):
+        art = tmp_path / "bad.json"
+        art.write_text(json.dumps({"schema": "nope/v1"}))
+        assert _run_gate(["--joint", str(art)]).returncode == 2
+
+    def test_bench_joint_regenerates_the_committed_artifact(self,
+                                                            tmp_path):
+        """bench_joint.py with the committed defaults reproduces the
+        committed comparison (modeled, deterministic)."""
+        out = tmp_path / "JOINT_SWEEP.json"
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "bench_joint.py"),
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=240,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr[-2000:]
+        fresh = json.loads(out.read_text())
+        with open(os.path.join(REPO, "JOINT_SWEEP_r18.json")) as f:
+            committed = json.load(f)
+        assert fresh["comparison"] == committed["comparison"]
+        assert fresh["signature"] == committed["signature"]

@@ -658,6 +658,27 @@ class TestStripedGateCLI:
         with pytest.raises(ValueError):
             _parse_link_gbps("ici")
 
+    def test_parse_link_gbps_names_accepted_classes(self):
+        """A typo'd link class fails loudly NAMING the accepted
+        LINK_CLASS values — the bench flag and plan_modeled_time_s
+        share one validator (planner.validate_link_gbps), so a typo
+        can never silently price a link class as free."""
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            from bench_allreduce import _parse_link_gbps
+        finally:
+            sys.path.pop(0)
+        from chainermn_tpu.planner import LINK_CLASS
+        accepted = sorted(set(LINK_CLASS.values()))
+        with pytest.raises(ValueError) as e:
+            _parse_link_gbps("icn=0.2,dcn=0.01")
+        msg = str(e.value)
+        assert "icn" in msg
+        for name in accepted:  # ["dcn", "ici"]
+            assert name in msg
+        with pytest.raises(ValueError, match="negative|>= 0|positive"):
+            _parse_link_gbps("ici=-1.0")
+
     def _doc(self, rows):
         return {"schema": "allreduce_sweep/v1", "backend": "cpu",
                 "n_devices": 8, "topology": "inter:2,intra:4",

@@ -314,6 +314,29 @@ run 0 "$OUT/ONLINE_TUNE_$ROUND.json" \
         && $PY_TPU tools/perf_gate.py \
             --online-tune '$OUT/ONLINE_TUNE_$ROUND.json'"
 
+# ---- global scheduler: joint-vs-independent workload tuning gate ------
+# Contention-aware joint plan tuning (docs/collective_planner.md "Joint
+# scheduling across communicators"): build the two-slot step workload
+# the contention observatory measures overlapping (bucketed-FSDP
+# gradient allreduce + MoE dispatch/combine all-to-all) on the 8-device
+# mesh shape, tune the slots independently (today's per-communicator
+# argmin) and jointly (planner.schedule.jointly_tune — coordinate
+# descent under the fair-share link simulator), and require the joint
+# schedule to beat independent by >=1.05x with at least one slot's plan
+# changed — the ceded-link decision (e.g. the striped allreduce gives
+# up its DCN stripe while the MoE exchange owns that wire).
+# Deterministic and device-free; comparison.speedup feeds the
+# joint_schedule_speedup budget.
+run 0 "$OUT/JOINT_SWEEP_$ROUND.json" \
+    "joint-schedule gate: jointly tune the allreduce+MoE step workload under shared links, require >=1.05x over independent tuning with >=1 ceded-link plan change" -- \
+    bash -c "$PY_TPU benchmarks/bench_joint.py \
+            --topology inter:2,intra:4 --link-gbps ici=0.2,dcn=0.02 \
+            --allreduce-kib 4096 --moe-kib 8192 \
+            --out '$OUT/JOINT_SWEEP_$ROUND.json' \
+        && $PY_TPU tools/perf_gate.py \
+            --joint '$OUT/JOINT_SWEEP_$ROUND.json' \
+            --out '$OUT/JOINT_GATE_$ROUND.json'"
+
 # ---- run ledger: backfill -> regression diff -> ledger gate -----------
 # Cross-run observatory (docs/observability.md "Run ledger & regression
 # diffing"): register every committed artifact as a run_manifest/v1

@@ -109,6 +109,7 @@ SERVING_SCHEMA = "bench_serving/v2"
 MOE_GATE_SCHEMA = "moe_gate/v1"
 MOE_BENCH_SCHEMA = "moe_bench/v1"
 LEDGER_GATE_SCHEMA = "ledger_gate/v1"
+JOINT_SWEEP_SCHEMA = "joint_sweep/v1"
 FLAT_ALLTOALL = "alltoall_flat"
 
 
@@ -327,6 +328,76 @@ def online_tune_gate(args):
             json.dump(report, f, indent=2)
             f.write("\n")
     print(json.dumps({"ok": ok, "best_speedup": best,
+                      "threshold": threshold}), flush=True)
+    if not ok:
+        for p in problems:
+            print(f"perf_gate: FAIL — {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def joint_gate(args):
+    """Gate a ``bench_joint`` artifact: the jointly-tuned workload must
+    beat independent per-communicator tuning by ``--joint-threshold``
+    under the shared-link model, AND change at least one slot's plan —
+    the ceded-link acceptance criterion for the global collective
+    scheduler (a "joint win" that picks the same plans everywhere is
+    just the independent tuner with extra steps)."""
+    with open(args.joint) as f:
+        doc = json.load(f)
+    if doc.get("schema") != JOINT_SWEEP_SCHEMA:
+        print(f"perf_gate: unsupported joint-sweep schema "
+              f"{doc.get('schema')!r} (want {JOINT_SWEEP_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    threshold = float(args.joint_threshold)
+    cmp = doc.get("comparison")
+    problems = []
+    if not isinstance(cmp, dict):
+        problems.append("no comparison block in artifact")
+        cmp = {}
+    speedup = cmp.get("speedup")
+    if speedup is None:
+        problems.append("comparison.speedup missing")
+    elif float(speedup) < threshold:
+        problems.append(f"comparison.speedup {float(speedup):.4f} below "
+                        f"gate threshold {threshold} — joint tuning "
+                        f"does not pay for itself on this workload")
+    changed = cmp.get("changed_slots", [])
+    if not changed:
+        problems.append("comparison.changed_slots empty — the joint "
+                        "schedule picked the independently-tuned plans "
+                        "(no ceded-link decision to gate)")
+    if not cmp.get("signature"):
+        problems.append("comparison.signature missing — joint table "
+                        "entry would not be recallable by workload")
+    ind = cmp.get("independent", {})
+    joint = cmp.get("joint", {})
+    for row in cmp.get("slots", []):
+        name = row.get("slot")
+        mark = " *" if name in changed else ""
+        print(f"perf_gate      slot {str(name):>10}: "
+              f"{row.get('independent_plan')} -> "
+              f"{row.get('joint_plan')}{mark}", file=sys.stderr)
+    ind_s, joint_s = ind.get("modeled_s"), joint.get("modeled_s")
+    if ind_s is not None and joint_s is not None:
+        print(f"perf_gate      workload {cmp.get('signature')}: "
+              f"independent {float(ind_s):.6f}s -> joint "
+              f"{float(joint_s):.6f}s", file=sys.stderr)
+    ok = not problems
+    report = {"schema": JOINT_SWEEP_SCHEMA + "+gate",
+              "artifact": os.path.basename(args.joint),
+              "threshold": threshold,
+              "speedup": speedup,
+              "changed_slots": changed,
+              "signature": cmp.get("signature"),
+              "problems": problems,
+              "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok, "speedup": speedup,
+                      "changed_slots": changed,
                       "threshold": threshold}), flush=True)
     if not ok:
         for p in problems:
@@ -738,6 +809,14 @@ def main():
                         metavar="X",
                         help="MoE mode: minimum bf16-DCN byte shrink at "
                              "the largest swept payload (default 1.8)")
+    parser.add_argument("--joint", default=None, metavar="JOINT_SWEEP.json",
+                        help="joint-schedule gate mode: bench_joint "
+                             f"artifact (schema {JOINT_SWEEP_SCHEMA}) "
+                             "whose jointly-tuned workload must beat "
+                             "independent tuning and change >=1 slot")
+    parser.add_argument("--joint-threshold", type=float, default=1.05,
+                        help="joint mode: minimum modeled "
+                             "comparison.speedup to pass (default 1.05)")
     parser.add_argument("--ledger", default=None, metavar="LEDGER.json",
                         help="ledger-gate mode: run-ledger JSONL or "
                              "run_ledger/v1 snapshot; budget metrics are "
@@ -749,14 +828,17 @@ def main():
     args = parser.parse_args()
     modes = [bool(args.budgets), bool(args.planner),
              bool(args.online_tune), bool(args.serving), bool(args.moe),
-             bool(args.ledger)]
+             bool(args.joint), bool(args.ledger)]
     if sum(modes) != 1:
         parser.error("pass exactly one of --budgets, --planner, "
-                     "--online-tune, --serving, --moe, or --ledger")
+                     "--online-tune, --serving, --moe, --joint, or "
+                     "--ledger")
     if args.planner:
         return planner_gate(args)
     if args.online_tune:
         return online_tune_gate(args)
+    if args.joint:
+        return joint_gate(args)
     if args.serving:
         return serving_gate(args)
     if args.moe:
