@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 from chainermn_tpu.parallel import topology as topo_mod
 from chainermn_tpu.runtime import control_plane as cp_mod
+from chainermn_tpu.utils.placement import local_device_put
 
 
 class _SplitControlPlane(cp_mod.ControlPlane):
@@ -631,7 +632,9 @@ CompressionState` from :meth:`init_compression_state`) and the call
             host_vals = self.bcast_obj(host_vals, root=0)
             params = host_vals
         repl = NamedSharding(self._mesh, P())
-        return jax.device_put(params, repl)
+        # after the control-plane bcast every host holds the bytes, so
+        # placement must stay process-local (utils/placement.py)
+        return local_device_put(params, repl)
 
     # ---- sub-communicators -------------------------------------------------
     def split(self, color: int, key: int) -> "MeshCommunicator":

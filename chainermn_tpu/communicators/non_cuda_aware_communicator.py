@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
+from chainermn_tpu.utils.placement import local_device_put
 
 
 class NonCudaAwareCommunicator(FlatCommunicator):
@@ -51,4 +52,5 @@ class NonCudaAwareCommunicator(FlatCommunicator):
             summed = self.allreduce_obj(host, op="sum")
             host = jax.tree.map(lambda a: np.asarray(a) / self.host_size, summed)
         repl = NamedSharding(self._mesh, P())
-        return jax.device_put(host, repl)
+        # every host holds the reduced value — place process-locally
+        return local_device_put(host, repl)

@@ -1,0 +1,41 @@
+"""Elastic runtime: preemption-tolerant training on top of the
+checkpoint + observability stack.
+
+Three cooperating pieces (docs/elasticity.md):
+
+* :class:`AsyncCheckpointer` — the ``backend="async"`` flavor of
+  :func:`~chainermn_tpu.extensions.checkpoint.
+  create_multi_node_checkpointer`: device->host snapshot at the step
+  boundary, npz persist on a background thread, with a write-barrier
+  before generation GC and an ``async_ckpt_stall_ms`` stall metric.
+* :class:`Supervisor` (driven by ``tools/elastic_run.py``) — launches
+  the multi-controller world, consumes watchdog/crash flight dumps,
+  writes a ``restart_manifest/v1`` artifact per incident and relaunches
+  from ``latest_consistent_generation()``.
+* :func:`resume_resized` / :func:`retune_plan_table` — world-resize
+  resume: reshard FSDP bucket shards into the new world, re-key
+  error-feedback compression state, and re-tune the collective plan
+  table for the new topology instead of refusing the mismatch.
+"""
+
+from chainermn_tpu.elastic.async_ckpt import AsyncCheckpointer
+from chainermn_tpu.elastic.manifest import (MANIFEST_SCHEMA,
+                                            build_restart_manifest,
+                                            write_restart_manifest)
+from chainermn_tpu.elastic.resize import (resize_report,
+                                          resume_resized,
+                                          retune_plan_table)
+from chainermn_tpu.elastic.supervisor import (Supervisor,
+                                              SupervisorConfig)
+
+__all__ = [
+    "AsyncCheckpointer",
+    "MANIFEST_SCHEMA",
+    "Supervisor",
+    "SupervisorConfig",
+    "build_restart_manifest",
+    "resize_report",
+    "resume_resized",
+    "retune_plan_table",
+    "write_restart_manifest",
+]

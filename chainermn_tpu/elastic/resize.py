@@ -1,0 +1,353 @@
+"""World-resize resume: reshard, re-key, re-tune — instead of refusing.
+
+The checkpoint sidecar pins the FSDP world size, and the plain
+``resume()`` path *refuses* a mismatched world (extensions/checkpoint.py
+— the right default: silently restoring mis-sharded arrays trains on
+garbage).  This module is the deliberate cross-size path the refusal
+messages point at, automated:
+
+* **reshard** — the stacked ``[size, shard]`` FSDP leaves in a saved
+  generation ARE the padded full buffers, just reshaped (the same fact
+  ``fsdp_full_params`` exploits), and ``partition_buckets``/``pack`` cut
+  buckets identically at every world size.  So resharding is a flat
+  reshape: strip the old world's pad, re-pad for the new world, reshape
+  to ``[new_size, new_shard]``.  Element-wise optimizer vectors (adam
+  mu/nu) follow their parameters through the same transform; replicated
+  rows (broadcast-stacked scalars like the adam step count) are detected
+  by content and re-broadcast.
+* **re-key** — per-rank error-feedback residuals and delayed scales are
+  bound to a rank's shard of the *old* world; they are dropped and the
+  new world starts from fresh EF state (the dropped residual norm is
+  recorded in the resize report, not silently discarded).  Per-hop
+  (group, stage) plan EF states are re-initialized for the new topology
+  via :func:`~chainermn_tpu.planner.compiler.
+  init_plan_compression_states` when the re-tuned plan quantizes a hop.
+* **re-tune** — the pinned ``__plan_table_meta__`` hash belongs to the
+  old topology; :func:`retune_plan_table` prices the candidate zoo for
+  the NEW topology (``synthesize_sweep_rows`` ->
+  ``autotune_from_rows``), hot-swaps it through the existing
+  ``swap_plan_table`` seam and re-registers the active-table pin — the
+  hash *change* is recorded in the resize report instead of refused.
+
+Resuming at the SAME world size falls through to the ordinary
+``checkpointer.resume`` (all refusal guards intact).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from chainermn_tpu.utils.placement import local_device_put
+
+_REPORT: Optional[dict] = None
+
+
+def resize_report() -> Optional[dict]:
+    """The report of the last :func:`resume_resized` in this process
+    (``None`` before any) — what the supervisor embeds in the restart
+    manifest."""
+    return _REPORT
+
+
+def _rows_equal(a: np.ndarray) -> bool:
+    """True when every row of the stacked leading axis is identical —
+    the signature of a broadcast-stacked (replicated) leaf."""
+    if a.ndim == 0 or a.shape[0] <= 1:
+        return True
+    return bool(np.all(a == a[:1]))
+
+
+def _resize_stacked(saved: np.ndarray, want_shape: Tuple[int, ...],
+                    report: dict) -> np.ndarray:
+    """Reshard one stacked ``[old_size, old_shard]`` buffer leaf to
+    ``want_shape`` = ``[new_size, new_shard]``: flatten (recovering the
+    old world's padded full buffer), re-pad with zeros or drop the old
+    tail pad, reshape.  The payload prefix is preserved exactly; only
+    the world-size pad region changes."""
+    want = tuple(int(s) for s in want_shape)
+    n_new = int(np.prod(want)) if want else 1
+    flat = np.asarray(saved).reshape(-1)
+    if flat.size < n_new:
+        flat = np.concatenate(
+            [flat, np.zeros(n_new - flat.size, flat.dtype)])
+    elif flat.size > n_new:
+        # the tail beyond the new padded length lies inside the OLD
+        # world's pad region (orig_len <= n_new always); quantization
+        # noise can leave it slightly nonzero, so record, don't refuse
+        tail = flat[n_new:]
+        report["dropped_pad_maxabs"] = max(
+            report.get("dropped_pad_maxabs", 0.0),
+            float(np.max(np.abs(tail))) if tail.size else 0.0)
+        flat = flat[:n_new]
+    report["resharded_leaves"] = report.get("resharded_leaves", 0) + 1
+    return flat.reshape(want)
+
+
+def _resize_fsdp_state(live_st, seg: List[np.ndarray], report: dict):
+    """Rebuild one FsdpState from its saved leaf run ``seg`` (old
+    world) against the freshly-initialized ``live_st`` (new world)."""
+    from chainermn_tpu.parallel.fsdp import FsdpState
+
+    sh_leaves, sh_def = jax.tree.flatten(live_st.shards)
+    in_leaves, in_def = jax.tree.flatten(live_st.inner)
+    cp_leaves, _ = jax.tree.flatten(live_st.comp)
+    need = len(sh_leaves) + len(in_leaves) + len(cp_leaves)
+    if len(seg) != need:
+        raise ValueError(
+            f"resize: checkpoint FsdpState run has {len(seg)} leaves "
+            f"but the new world's FsdpState has {need} "
+            f"(shards={len(sh_leaves)}, inner={len(in_leaves)}, "
+            f"comp={len(cp_leaves)}) — the bucket/optimizer/compression "
+            f"config must match the saving run; only the world size may "
+            f"differ on the resize path")
+    pos = 0
+    new_sh = []
+    for live in sh_leaves:
+        new_sh.append(_resize_stacked(seg[pos], np.shape(live), report))
+        pos += 1
+    new_in = []
+    for live in in_leaves:
+        saved = np.asarray(seg[pos])
+        pos += 1
+        want = tuple(int(s) for s in np.shape(live))
+        if saved.shape == want:
+            new_in.append(saved)
+        elif saved.shape[1:] == want[1:] and _rows_equal(saved):
+            # broadcast-stacked scalar state (e.g. the adam step
+            # count): every old rank agreed, re-broadcast to the new
+            # stack height
+            new_in.append(np.broadcast_to(saved[:1], want).copy())
+            report["replicated_leaves"] = \
+                report.get("replicated_leaves", 0) + 1
+        else:
+            # shard-following state (adam mu/nu ride the same flat
+            # layout as their parameters)
+            new_in.append(_resize_stacked(saved, want, report))
+    # per-rank EF residual + delayed scale are bound to the OLD world's
+    # shards: re-key (fresh zeros from the new fsdp_init), record what
+    # was dropped
+    if cp_leaves:
+        dropped = 0.0
+        for _ in cp_leaves:
+            dropped += float(np.linalg.norm(
+                np.asarray(seg[pos]).ravel()))
+            pos += 1
+        report["rekeyed_comp_states"] = \
+            report.get("rekeyed_comp_states", 0) + \
+            sum(1 for _ in _iter_comp(live_st.comp))
+        report["dropped_ef_norm"] = \
+            report.get("dropped_ef_norm", 0.0) + dropped
+    return FsdpState(shards=jax.tree.unflatten(sh_def, new_sh),
+                     inner=jax.tree.unflatten(in_def, new_in),
+                     comp=live_st.comp)
+
+
+def _iter_comp(comp):
+    from chainermn_tpu.compression.error_feedback import \
+        iter_compression_states
+    return iter_compression_states(comp)
+
+
+def _find_resizable_generation(ckpt) -> Optional[Tuple[int, int]]:
+    """Newest generation with a complete, readable rank set in the
+    checkpoint directory, regardless of the CURRENT world size.
+    Returns ``(generation, old_world_ranks)`` or ``None``.  The rank
+    set of a generation must be contiguous from 0 (rank files of the
+    saving world); readability is the same CRC check the consistent-
+    generation vote applies."""
+    by_gen = ckpt._all_rank_generations()
+    for g in sorted(by_gen, reverse=True):
+        ranks = by_gen[g]
+        n = len(ranks)
+        if ranks != set(range(n)):
+            continue
+        if all(ckpt._is_readable(ckpt._file(g, rank=r)) for r in ranks):
+            return g, n
+    return None
+
+
+def resume_resized(checkpointer, state, communicator=None,
+                   link_gbps: Optional[Dict[str, float]] = None):
+    """Resume ``state`` (freshly built for the CURRENT world) from the
+    newest complete generation in ``checkpointer``'s directory, even
+    when that generation was saved at a different world size.
+
+    Returns ``(state, generation, report)`` — ``generation`` is None on
+    a fresh start.  When the saved world size matches the current one
+    this is exactly ``checkpointer.resume`` (every sidecar refusal
+    guard intact) with an empty report.  Otherwise the FSDP shards are
+    resharded, EF state re-keyed, and — when the saving run had pinned a
+    hot-swapped plan table and ``communicator`` supports
+    ``swap_plan_table`` — the table is re-tuned for the new topology
+    (:func:`retune_plan_table`), the old->new hash change recorded in
+    the report.
+    """
+    global _REPORT
+    from chainermn_tpu.extensions.checkpoint import (
+        _COMPRESSION_META_KEY, _FSDP_META_KEY, _PLAN_TABLE_META_KEY)
+    from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.parallel.fsdp import FsdpState
+
+    comm = checkpointer.comm
+    if hasattr(checkpointer, "drain"):  # async backend: write-barrier
+        checkpointer.drain()
+    files = getattr(checkpointer, "_inner", checkpointer)
+    found = _find_resizable_generation(files)
+    if found is None:
+        return state, None, {}
+    gen, n_ctrl = found
+    # the DEVICE world the generation was saved at comes from the FSDP
+    # sidecar (stack height), not the controller-rank file count — a
+    # single controller can own any number of devices
+    with np.load(files._file(gen, rank=0)) as data0:
+        raw0 = data0[_FSDP_META_KEY] \
+            if _FSDP_META_KEY in data0.files else None
+        peek = json.loads(str(raw0)) if raw0 is not None else None
+    old_world = int(peek["world_size"]) if peek is not None else comm.size
+    same_ctrl = n_ctrl == int(getattr(comm, "host_size", 1) or 1)
+    if old_world == comm.size and same_ctrl:
+        restored, it = checkpointer.resume(state)
+        _REPORT = {"generation": it, "from_world": old_world,
+                   "to_world": comm.size, "resized": False}
+        return restored, it, _REPORT
+    report: dict = {"generation": gen, "from_world": old_world,
+                    "to_world": comm.size, "resized": True,
+                    "controllers": {"saved": n_ctrl,
+                                    "now": int(getattr(comm, "host_size",
+                                                       1) or 1)}}
+    fr = _flight.get_flight_recorder()
+    tok = None
+    if fr is not None:
+        tok = fr.span_begin("checkpoint", "checkpoint_resume_resized",
+                            generation=gen, from_world=old_world,
+                            to_world=comm.size)
+    try:
+        # every rank file of a generation holds the same GLOBAL arrays
+        # (device_get of the sharded stack materializes the full
+        # buffer), so rank 0's file serves every new rank
+        with np.load(files._file(gen, rank=0)) as data:
+            arrays = {k: data[k] for k in data.files}
+        arrays.pop(_FSDP_META_KEY, None)
+        arrays.pop(_COMPRESSION_META_KEY, None)
+        saved_t = arrays.pop(_PLAN_TABLE_META_KEY, None)
+        saved_t = json.loads(str(saved_t)) if saved_t is not None else None
+        n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
+        saved_leaves = [arrays[f"leaf_{i}"] for i in range(n_saved)]
+        live_outer, outer_def = jax.tree.flatten(
+            state, is_leaf=lambda x: isinstance(x, FsdpState))
+        pos = 0
+        out = []
+        for live in live_outer:
+            if isinstance(live, FsdpState):
+                n = len(jax.tree.leaves(live))
+                seg = saved_leaves[pos:pos + n]
+                pos += n
+                out.append(_resize_fsdp_state(live, seg, report))
+                continue
+            if pos >= n_saved:
+                raise ValueError(
+                    f"resize: checkpoint generation {gen} has "
+                    f"{n_saved} leaves but the new state needs more — "
+                    f"the state structure changed beyond the world "
+                    f"size; only same-structure resumes can be "
+                    f"resharded")
+            saved = np.asarray(saved_leaves[pos])
+            pos += 1
+            want = tuple(int(s)
+                         for s in (getattr(live, "shape", ()) or ()))
+            if saved.shape == want:
+                out.append(saved)
+            else:
+                raise ValueError(
+                    f"resize: non-FSDP leaf saved with shape "
+                    f"{tuple(saved.shape)} but the new world expects "
+                    f"{want} — only FsdpState shards/optimizer state "
+                    f"reshard across world sizes; replicated leaves "
+                    f"must keep their shape")
+        if pos != n_saved:
+            raise ValueError(
+                f"resize: checkpoint generation {gen} has {n_saved} "
+                f"leaves but the new state consumed {pos} — the state "
+                f"structure changed beyond the world size")
+        restored = jax.tree.unflatten(outer_def, out)
+        # process-local placement — see utils/placement.py for the
+        # cross-process device_put ordering hazard
+        restored = jax.tree.map(
+            lambda new, old: local_device_put(new, old.sharding)
+            if hasattr(old, "sharding") else new,
+            restored, state)
+        # plan-table pin: re-tune for the new topology rather than
+        # refusing the saved hash (the hash CHANGE is the record)
+        if saved_t is not None:
+            if communicator is not None \
+                    and hasattr(communicator, "swap_plan_table"):
+                report["plan_table"] = retune_plan_table(
+                    communicator, link_gbps=link_gbps, step=gen,
+                    old_meta=saved_t)
+            else:
+                from chainermn_tpu.planner.online import \
+                    clear_active_plan_table
+                clear_active_plan_table()
+                report["plan_table"] = {
+                    "old": saved_t, "new": None,
+                    "action": "cleared (no tunable communicator — "
+                              "plans fall back to the flavor default)"}
+    finally:
+        if tok is not None:
+            fr.span_end(tok)
+    _REPORT = report
+    return restored, gen, report
+
+
+def retune_plan_table(communicator,
+                      link_gbps: Optional[Dict[str, float]] = None,
+                      nbytes_grid=(1 << 20, 16 << 20),
+                      dtype: str = "float32",
+                      step: Optional[int] = None,
+                      old_meta: Optional[dict] = None) -> dict:
+    """Re-tune the collective plan table for ``communicator``'s
+    (post-resize) topology and hot-swap it through the existing
+    ``swap_plan_table`` / ``set_active_plan_table`` seam.
+
+    Prices the candidate zoo with modeled link rates
+    (:func:`~chainermn_tpu.planner.online.synthesize_sweep_rows` — the
+    online tuner's fallback pricing when no observation window exists
+    yet, which is exactly the situation right after a restart) and
+    selects per size-bucket with the offline
+    :func:`~chainermn_tpu.planner.autotune.autotune_from_rows` logic.
+    Returns ``{"old", "new", "topology"}`` with both table hashes — the
+    recorded, not refused, hash change.
+    """
+    from chainermn_tpu.observability import flight_recorder as _flight
+    from chainermn_tpu.planner.online import (active_plan_table_meta,
+                                              set_active_plan_table,
+                                              synthesize_sweep_rows)
+    from chainermn_tpu.planner.autotune import autotune_from_rows
+
+    if old_meta is None:
+        old_meta = active_plan_table_meta()
+    topo = communicator.plan_topology()
+    rates = dict(link_gbps) if link_gbps else {"ici": 10.0, "dcn": 1.0}
+    rows: List[dict] = []
+    for nbytes in nbytes_grid:
+        rows.extend(synthesize_sweep_rows(topo, dtype, int(nbytes), rates))
+    table, _ = autotune_from_rows(rows)
+    communicator.swap_plan_table(table)
+    new_meta = set_active_plan_table(
+        table, step=step,
+        evidence={"kind": "elastic_resize", "topology": topo.key(),
+                  "link_gbps": rates})
+    fr = _flight.get_flight_recorder()
+    if fr is not None:
+        fr.record("planner", op="elastic_plan_retune",
+                  topology=topo.key(),
+                  old_hash=(old_meta or {}).get("table_hash"),
+                  new_hash=new_meta["table_hash"])
+    return {"old": old_meta, "new": new_meta, "topology": topo.key()}
+
+
+__all__ = ["resize_report", "resume_resized", "retune_plan_table"]

@@ -1,0 +1,328 @@
+"""Watchdog-driven auto-restart supervisor.
+
+Owns the loop the paper's fail-stop posture implies but the reference
+never automated: launch the N-controller world (the ``spawn_world`` env
+contract — ``utils/proc_world.py`` is the one copy of the choreography
+this mirrors), watch the children, and when one dies — SIGKILLed by a
+preemption, wedged past the watchdog deadline, or crashed — kill the
+survivors (blocked in collectives against a dead peer, they will never
+exit on their own), harvest every ``flight_<rank>.json`` the watchdog /
+crash handlers left, write a ``restart_manifest/v1`` naming the
+incident, and relaunch.  The relaunched workers resume from
+``latest_consistent_generation()`` themselves (or
+:func:`~chainermn_tpu.elastic.resize.resume_resized` when the new
+attempt runs a different world size — the ``resize_schedule`` knob);
+with per-step saves that bounds lost work to <1 step.
+
+``tools/elastic_run.py`` is the CLI over this class;
+``tools/elastic_smoke.py`` drives it under fault injection.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+import zipfile
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from chainermn_tpu.elastic.manifest import (build_restart_manifest,
+                                            write_restart_manifest)
+from chainermn_tpu.utils.proc_world import free_port_pair
+
+
+def scan_latest_generation(path: str, name: str = "snapshot",
+                           n_ranks: Optional[int] = None) -> Optional[int]:
+    """Newest generation in a checkpoint directory whose rank files form
+    a complete, readable set — the supervisor-side, communicator-free
+    mirror of ``latest_consistent_generation`` + the resize path's
+    all-rank scan.  ``n_ranks`` pins how many rank files make a
+    generation complete (the next attempt's world size); without it a
+    set contiguous from 0 is trusted, which over-reports when one rank
+    raced a generation ahead before the crash (its lone ``rank0`` file
+    would look complete).  Returns ``None`` when the directory holds
+    nothing resumable."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return None
+    pat = re.compile(rf"^{re.escape(name)}\.(\d+)\.rank(\d+)\.npz$")
+    by_gen: Dict[int, set] = {}
+    for f in names:
+        m = pat.match(f)
+        if m:
+            by_gen.setdefault(int(m.group(1)), set()).add(int(m.group(2)))
+    for g in sorted(by_gen, reverse=True):
+        ranks = by_gen[g]
+        want = set(range(len(ranks) if n_ranks is None else n_ranks))
+        if not want <= ranks:
+            # stale files from a LARGER pre-resize world are fine
+            # (supersets); missing needed ranks are not
+            continue
+        ok = True
+        for r in want:
+            fn = os.path.join(path, f"{name}.{g}.rank{r}.npz")
+            try:
+                with zipfile.ZipFile(fn) as z:
+                    if z.testzip() is not None:
+                        ok = False
+                        break
+            except Exception:
+                ok = False
+                break
+        if ok:
+            return g
+    return None
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs of one supervised run."""
+    n_procs: int = 2                 # controllers per attempt
+    local_devices: int = 4           # CPU devices per controller
+    max_restarts: int = 3            # incidents tolerated before giving up
+    attempt_timeout_s: float = 600.0
+    dump_dir: str = "."              # where children write flight dumps
+    out_dir: str = "."               # where restart manifests land
+    ckpt_path: Optional[str] = None  # checkpoint dir (resume reporting)
+    ckpt_name: str = "snapshot"
+    repo: Optional[str] = None
+    #: world size per attempt (index clamped to the last entry); None
+    #: keeps ``n_procs`` — a shrinking schedule is how a preempted-host
+    #: run continues on the surviving slice (elastic resize)
+    resize_schedule: Optional[Sequence[int]] = None
+    #: extra env for every child (watchdog knobs ride here — e.g.
+    #: ``WatchdogConfig(...).to_env()``)
+    env: Dict[str, str] = field(default_factory=dict)
+    poll_interval_s: float = 0.1
+
+    def world_for_attempt(self, attempt: int) -> int:
+        if not self.resize_schedule:
+            return self.n_procs
+        i = min(attempt, len(self.resize_schedule) - 1)
+        return int(self.resize_schedule[i])
+
+
+class Supervisor:
+    """Launch / monitor / manifest / relaunch loop over one worker
+    program (a ``python -c`` source string, the ``spawn_world``
+    convention: workers bootstrap from the ``CHAINERMN_TPU_*`` env
+    contract and print a ``RESULT {json}`` line).
+
+    ``on_incident(manifest_doc)`` / ``on_recovered(attempt)`` hooks let
+    a serving harness drain a lost replica's sessions from its
+    :class:`~chainermn_tpu.serving.router.Router` while the world is
+    down and re-admit them once the relaunch is up."""
+
+    def __init__(self, worker_src: str, config: SupervisorConfig,
+                 on_incident: Optional[Callable[[dict], None]] = None,
+                 on_recovered: Optional[Callable[[int], None]] = None):
+        self.worker_src = worker_src
+        self.cfg = config
+        self.on_incident = on_incident
+        self.on_recovered = on_recovered
+        self.manifests: List[str] = []
+        self.incidents: List[dict] = []
+        self.attempts: List[dict] = []
+        self._procs: List[subprocess.Popen] = []
+
+    # ---- child lifecycle ---------------------------------------------------
+    def _launch(self, attempt: int) -> List[subprocess.Popen]:
+        cfg = self.cfg
+        n = cfg.world_for_attempt(attempt)
+        repo = cfg.repo or os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        # fresh port pair per attempt: the previous attempt's (possibly
+        # dead) coordinator cannot be rebound reliably
+        coord = f"127.0.0.1:{free_port_pair()}"
+        procs = []
+        for r in range(n):
+            env = dict(os.environ)
+            env.update({
+                "CHAINERMN_TPU_COORDINATOR": coord,
+                "CHAINERMN_TPU_NUM_PROCESSES": str(n),
+                "CHAINERMN_TPU_PROCESS_ID": str(r),
+                "CHAINERMN_TPU_REPO": repo,
+                "PYTHONPATH": repo,
+                "JAX_PLATFORMS": "cpu",
+                "JAX_NUM_CPU_DEVICES": str(cfg.local_devices),
+                "CHAINERMN_TPU_FLIGHT_DIR": cfg.dump_dir,
+                "CHAINERMN_TPU_ELASTIC_ATTEMPT": str(attempt),
+            })
+            env.update(cfg.env)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", self.worker_src], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        return procs
+
+    def _kill_survivors(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _harvest_dumps_dir(self) -> str:
+        return self.cfg.dump_dir
+
+    def _clear_dumps(self):
+        """Drop harvested flight dumps so the next attempt's evidence
+        window starts clean (they live on, embedded in the manifest)."""
+        import glob
+        for f in glob.glob(os.path.join(self.cfg.dump_dir,
+                                        "flight_*.json")):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+
+    # ---- the loop ----------------------------------------------------------
+    def run(self) -> dict:
+        """Supervise until an attempt completes cleanly or the restart
+        budget is exhausted.  Returns ``{"results", "attempts",
+        "manifests", "incidents"}``; raises ``RuntimeError`` after
+        ``max_restarts`` failed attempts (with every manifest already
+        on disk)."""
+        attempt = 0
+        incident = 0
+        while True:
+            world = self.cfg.world_for_attempt(attempt)
+            resume_gen = scan_latest_generation(
+                self.cfg.ckpt_path, self.cfg.ckpt_name, n_ranks=world) \
+                if self.cfg.ckpt_path else None
+            started = time.time()
+            self._procs = self._launch(attempt)
+            failure = self._watch()
+            record = {"attempt": attempt, "world": world,
+                      "resume_generation": resume_gen,
+                      "duration_s": time.time() - started,
+                      "failure": failure}
+            self.attempts.append(record)
+            if failure is None:
+                results = self._collect_results()
+                if self.on_recovered is not None and attempt > 0:
+                    self.on_recovered(attempt)
+                return {"results": results, "attempts": self.attempts,
+                        "manifests": self.manifests,
+                        "incidents": self.incidents}
+            # incident: survivors are already dead (killed by _watch);
+            # manifest the evidence, then decide whether to relaunch
+            next_world = self.cfg.world_for_attempt(attempt + 1)
+            next_gen = scan_latest_generation(
+                self.cfg.ckpt_path, self.cfg.ckpt_name,
+                n_ranks=next_world) \
+                if self.cfg.ckpt_path else None
+            doc = build_restart_manifest(
+                incident=incident, reason=failure["reason"],
+                dump_dir=self._harvest_dumps_dir(),
+                exit_codes=failure["exit_codes"],
+                resume_generation=next_gen,
+                attempt=attempt,
+                world_before=world, world_after=next_world,
+                watchdog_config=self._watchdog_env_view(),
+                extra={"stderr_tails": failure["stderr_tails"]})
+            path = write_restart_manifest(doc, self.cfg.out_dir)
+            self.manifests.append(path)
+            self.incidents.append({"incident": incident,
+                                   "reason": failure["reason"],
+                                   "manifest": path})
+            if self.on_incident is not None:
+                self.on_incident(doc)
+            self._clear_dumps()
+            incident += 1
+            attempt += 1
+            if incident > self.cfg.max_restarts:
+                raise RuntimeError(
+                    f"elastic supervisor: gave up after {incident} "
+                    f"incidents (max_restarts={self.cfg.max_restarts}); "
+                    f"manifests: {self.manifests}")
+
+    def _watchdog_env_view(self) -> Optional[dict]:
+        wd = {k: v for k, v in self.cfg.env.items()
+              if k.startswith("CHAINERMN_TPU_WATCHDOG")}
+        return wd or None
+
+    def _watch(self) -> Optional[dict]:
+        """Poll the children until all exit cleanly (None) or a failure
+        is detected (dict with reason / exit codes / stderr tails; every
+        survivor killed before returning)."""
+        deadline = time.monotonic() + self.cfg.attempt_timeout_s
+        while True:
+            states = [p.poll() for p in self._procs]
+            bad = [(r, st) for r, st in enumerate(states)
+                   if st is not None and st != 0]
+            if bad:
+                r0, st0 = bad[0]
+                reason = (f"rank {r0} exited rc={st0}"
+                          + (" (killed)" if st0 < 0 else ""))
+                # give the surviving watchdogs a moment to notice the
+                # heartbeat loss and dump before we take them down
+                self._await_survivor_dumps()
+                return self._failure(reason, states)
+            if all(st is not None for st in states):
+                return None
+            if time.monotonic() > deadline:
+                alive = [r for r, st in enumerate(states) if st is None]
+                return self._failure(
+                    f"attempt timeout after "
+                    f"{self.cfg.attempt_timeout_s:.0f}s; rank(s) "
+                    f"{alive} still running", states)
+            time.sleep(self.cfg.poll_interval_s)
+
+    def _await_survivor_dumps(self, window_s: float = 3.0):
+        """Brief grace window after a death: surviving ranks' watchdogs
+        (heartbeat-loss predicate) or SIGTERM handlers may still be
+        writing their flight dumps."""
+        import glob
+        deadline = time.monotonic() + window_s
+        alive = [p for p in self._procs if p.poll() is None]
+        if not alive:
+            return
+        want = len(self._procs)
+        while time.monotonic() < deadline:
+            have = len(glob.glob(os.path.join(
+                self.cfg.dump_dir, "flight_*.json")))
+            if have >= want - 1:  # the killed rank leaves none
+                return
+            if all(p.poll() is not None for p in alive):
+                return
+            time.sleep(0.1)
+
+    def _failure(self, reason: str, states) -> dict:
+        self._kill_survivors()
+        tails = {}
+        codes = {}
+        for r, p in enumerate(self._procs):
+            codes[r] = p.poll()
+            try:
+                _, err = p.communicate(timeout=5.0)
+            except Exception:
+                err = ""
+            if err:
+                tails[str(r)] = err[-2000:]
+        return {"reason": reason, "exit_codes": codes,
+                "stderr_tails": tails}
+
+    def _collect_results(self) -> Dict[int, dict]:
+        import json as _json
+        results: Dict[int, dict] = {}
+        for r, p in enumerate(self._procs):
+            try:
+                out, _ = p.communicate(timeout=10.0)
+            except Exception:
+                out = ""
+            for line in (out or "").splitlines():
+                if line.startswith("RESULT "):
+                    results[r] = _json.loads(line[len("RESULT "):])
+                    break
+        return results
+
+
+__all__ = ["Supervisor", "SupervisorConfig", "scan_latest_generation"]

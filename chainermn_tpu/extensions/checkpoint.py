@@ -19,10 +19,13 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, List, Optional, Tuple
+import zipfile
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
+
+from chainermn_tpu.utils.placement import local_device_put
 
 # Sidecar keys persisted next to the leaf_{i} arrays in each npz: the
 # FSDP sharding layout (world size + shard lengths) so a resume into a
@@ -52,6 +55,18 @@ def _unflatten_state(arrays: dict, treedef, like_leaves: List[Any]):
     return jax.tree.unflatten(treedef, leaves)
 
 
+def _place_like(new, old):
+    """Place one restored host array with the LIVE leaf's sharding.
+    Restores must never cross processes — every rank's npz holds what
+    its own devices need — so this rides ``local_device_put`` (see
+    utils/placement.py for the gloo interleaving hazard a plain
+    ``jax.device_put`` carries on multi-controller meshes)."""
+    shd = getattr(old, "sharding", None)
+    if shd is None:
+        return new
+    return local_device_put(new, shd)
+
+
 class _MultiNodeCheckpointer:
     def __init__(self, comm, path: str, name: str, keep: int = 2):
         self.comm = comm
@@ -77,9 +92,49 @@ class _MultiNodeCheckpointer:
         return sorted(gens)
 
     # -- save / GC -----------------------------------------------------------
+    def _snapshot_arrays(self, state) -> dict:
+        """Device->host copy plus sidecar capture — the only part of a
+        save that must happen at the step boundary.  Returns the full
+        npz payload (leaf arrays + layout/compression/plan-table
+        sidecars); :meth:`_persist` can then write it from any thread
+        (the async backend's split — elastic/async_ckpt.py)."""
+        from chainermn_tpu.parallel.fsdp import fsdp_layout
+
+        arrays, _ = _flatten_state(state)
+        layout = fsdp_layout(state)
+        if layout is not None:
+            # persist the FsdpMeta-derived layout so resume() can
+            # validate world size / mode before touching the arrays
+            arrays[_FSDP_META_KEY] = np.array(json.dumps(layout))
+        from chainermn_tpu.compression import compression_layout
+        clayout = compression_layout(state)
+        if clayout is not None:
+            # ditto for error-feedback compression state (FSDP
+            # bucket compressors or a compressed optimizer)
+            arrays[_COMPRESSION_META_KEY] = np.array(
+                json.dumps(clayout))
+        from chainermn_tpu.planner.online import active_plan_table_meta
+        tmeta = active_plan_table_meta()
+        if tmeta is not None:
+            # pin the hot-swapped plan table's hash so resume can
+            # refuse a silently different plan (planner/online.py)
+            arrays[_PLAN_TABLE_META_KEY] = np.array(json.dumps(tmeta))
+        return arrays
+
+    def _persist(self, arrays: dict, iteration: int):
+        """Write + atomically publish one snapshot, then GC.  The GC
+        runs strictly after ``os.replace`` — the write-barrier the async
+        backend relies on: a generation can never be collected while the
+        one superseding it is still a torn temp file."""
+        # np.savez appends .npz when missing, so the temp name must
+        # end in it
+        tmp = self._file(iteration) + ".tmp.npz"
+        np.savez(tmp, **arrays)
+        os.replace(tmp, self._file(iteration))  # atomic publish
+        self._gc()
+
     def save(self, state, iteration: int):
         from chainermn_tpu.observability import flight_recorder as _flight
-        from chainermn_tpu.parallel.fsdp import fsdp_layout
 
         fr = _flight.get_flight_recorder()
         tok = None
@@ -87,46 +142,78 @@ class _MultiNodeCheckpointer:
             tok = fr.span_begin("checkpoint", "checkpoint_save",
                                 iteration=iteration)
         try:
-            arrays, _ = _flatten_state(state)
-            layout = fsdp_layout(state)
-            if layout is not None:
-                # persist the FsdpMeta-derived layout so resume() can
-                # validate world size / mode before touching the arrays
-                arrays[_FSDP_META_KEY] = np.array(json.dumps(layout))
-            from chainermn_tpu.compression import compression_layout
-            clayout = compression_layout(state)
-            if clayout is not None:
-                # ditto for error-feedback compression state (FSDP
-                # bucket compressors or a compressed optimizer)
-                arrays[_COMPRESSION_META_KEY] = np.array(
-                    json.dumps(clayout))
-            from chainermn_tpu.planner.online import active_plan_table_meta
-            tmeta = active_plan_table_meta()
-            if tmeta is not None:
-                # pin the hot-swapped plan table's hash so resume can
-                # refuse a silently different plan (planner/online.py)
-                arrays[_PLAN_TABLE_META_KEY] = np.array(json.dumps(tmeta))
-            # np.savez appends .npz when missing, so the temp name must
-            # end in it
-            tmp = self._file(iteration) + ".tmp.npz"
-            np.savez(tmp, **arrays)
-            os.replace(tmp, self._file(iteration))  # atomic publish
-            self._gc()
+            self._persist(self._snapshot_arrays(state), iteration)
         finally:
             if tok is not None:
                 fr.span_end(tok)
 
+    def _all_rank_generations(self) -> Dict[int, Set[int]]:
+        """generation -> ranks with a published file, from one directory
+        scan (all ranks, not just our own)."""
+        pat = re.compile(
+            rf"^{re.escape(self.name)}\.(\d+)\.rank(\d+)\.npz$")
+        out: Dict[int, Set[int]] = {}
+        for f in os.listdir(self.path):
+            m = pat.match(f)
+            if m:
+                out.setdefault(int(m.group(1)), set()).add(int(m.group(2)))
+        return out
+
     def _gc(self):
+        """Collect generations past ``keep`` — but never one some rank
+        in the *current* world still needs.  A crashed peer may be one
+        or more generations behind: deleting our copy of the newest
+        generation it shares with us would leave the world with no
+        consistent generation at all.  So only generations strictly
+        older than the newest generation *complete* across every rank
+        visible in the directory (capped at ``comm.size`` — files from
+        a larger pre-resize world don't pin anything) are collected.
+        On per-host directories only our own rank is visible and this
+        degrades to the plain keep-newest policy."""
+        if not self.keep:
+            return
         gens = self._local_generations()
-        for g in gens[:-self.keep] if self.keep else []:
+        candidates = gens[:-self.keep]
+        if not candidates:
+            return
+        by_gen = self._all_rank_generations()
+        present: Set[int] = set()
+        for ranks in by_gen.values():
+            present |= {r for r in ranks if r < self.comm.size}
+        complete = [g for g, ranks in by_gen.items()
+                    if present and present <= ranks]
+        newest_complete = max(complete) if complete else None
+        for g in candidates:
+            if newest_complete is not None and g >= newest_complete:
+                # still (part of) the newest world-consistent
+                # generation — a lagging peer resumes from here
+                continue
             try:
                 os.remove(self._file(g))
             except OSError:
                 pass
 
     # -- resume --------------------------------------------------------------
+    def _is_readable(self, fn: str) -> bool:
+        """True when the npz at ``fn`` is a complete, CRC-clean zip.  A
+        rank killed mid-write leaves its *previous* generation intact
+        (the temp-rename publish), but a torn filesystem / truncated
+        copy can still surface — such a file must not be offered as a
+        resumable generation."""
+        try:
+            with zipfile.ZipFile(fn) as z:
+                return z.testzip() is None
+        except Exception:
+            return False
+
     def latest_consistent_generation(self) -> Optional[int]:
-        local = set(self._local_generations())
+        """Newest generation every rank holds a *readable* file for.
+        Each rank CRC-checks its local candidates first (truncated or
+        torn npz files are excluded before the vote), so one corrupted
+        rank file degrades the answer to the previous complete
+        generation instead of crashing the resume."""
+        local = set(g for g in self._local_generations()
+                    if self._is_readable(self._file(g)))
         all_gens = self.comm.allgather_obj(sorted(local))
         common = set(all_gens[0])
         for g in all_gens[1:]:
@@ -285,11 +372,9 @@ class _MultiNodeCheckpointer:
                 arrays = {k: data[k] for k in data.files}
             self._validate_restore(arrays, state, leaves, gen)
             restored = _unflatten_state(arrays, treedef, leaves)
-            # preserve shardings of the live state
-            restored = jax.tree.map(
-                lambda new, old: jax.device_put(new, old.sharding)
-                if hasattr(old, "sharding") else new,
-                restored, state)
+            # preserve shardings of the live state (host-local placement;
+            # see _place_like for why this must not cross processes)
+            restored = jax.tree.map(_place_like, restored, state)
         finally:
             if tok is not None:
                 fr.span_end(tok)
@@ -409,6 +494,11 @@ def create_multi_node_checkpointer(communicator, path: str,
     is the self-contained per-rank format; ``backend="orbax"`` delegates
     to the TPU ecosystem's checkpoint library (sharded arrays, async
     commit protocol, same save/resume/GC interface).
+    ``backend="async"`` wraps the npz format in the elastic runtime's
+    background-persist thread (:class:`chainermn_tpu.elastic.
+    AsyncCheckpointer`): ``save`` only pays the device->host snapshot at
+    the step boundary and the npz write happens off the critical path
+    (``async_ckpt_stall_ms`` in docs/elasticity.md).
 
     ``keep`` retains the newest *keep* generations in both backends;
     ``keep=0`` disables garbage collection entirely (every generation is
@@ -419,7 +509,11 @@ def create_multi_node_checkpointer(communicator, path: str,
                          f"0 means retain every generation")
     if backend == "orbax":
         return _OrbaxCheckpointer(communicator, path, name, keep)
+    if backend == "async":
+        from chainermn_tpu.elastic.async_ckpt import AsyncCheckpointer
+        return AsyncCheckpointer(
+            _MultiNodeCheckpointer(communicator, path, name, keep))
     if backend != "npz":
         raise ValueError(f"unknown checkpoint backend {backend!r} "
-                         "(expected 'npz' or 'orbax')")
+                         "(expected 'npz', 'async' or 'orbax')")
     return _MultiNodeCheckpointer(communicator, path, name, keep)

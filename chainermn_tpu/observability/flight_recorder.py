@@ -255,7 +255,11 @@ class FlightRecorder:
             "reason": reason,
             # events the ring overwrote before this dump — a nonzero
             # count means the timeline below is missing its oldest part
+            # (the restart-manifest evidence stamp reads all three: the
+            # PR 16 telemetry truncation convention at crash time)
             "dropped_events": int(self.dropped_events),
+            "ring_capacity": int(self.capacity),
+            "evidence_truncated": bool(self.dropped_events),
             "collective_state": local_state,
             "events": self.snapshot(),
             "threads": thread_stacks(),

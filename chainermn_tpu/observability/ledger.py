@@ -79,6 +79,8 @@ KNOWN_SCHEMAS = {
     "bench_headline/v1",
     "cmn_lint/v1",
     "db_overlap_check/v1",
+    "restart_manifest/v1",
+    "elastic_smoke/v1",
     # the longitudinal layer itself
     "run_manifest/v1",
     "run_ledger/v1",
@@ -139,6 +141,9 @@ _METRIC_PATHS: Dict[str, Dict[str, str]] = {
     "remat_tune/v1": {"fused_norm_speedup": "fused_norm.speedup"},
     "joint_sweep/v1": {
         "joint_schedule_speedup": "comparison.speedup"},
+    "elastic_smoke/v1": {
+        "async_ckpt_stall_ms": "async_ckpt.stall_ms",
+        "elastic_resume_lost_steps": "chaos.lost_steps"},
 }
 
 

@@ -86,6 +86,17 @@ class WatchdogConfig:
         "out_dir": "CHAINERMN_TPU_FLIGHT_DIR",
     }
 
+    # Timeout/interval knobs that must be > 0: a launcher exporting a
+    # zero or negative value would not "turn the check off", it would
+    # silently break the predicate (a <=0 deadline fires on every open
+    # span; a <=0 heartbeat timeout declares every peer dead).  The
+    # deliberate off-switch is CHAINERMN_TPU_WATCHDOG_HEARTBEAT<=0
+    # (heartbeat_interval_s), which start() honors by not spawning the
+    # heartbeat thread — so that one knob stays out of this set.
+    _POSITIVE = ("deadline_s", "step_stall_factor",
+                 "heartbeat_timeout_s", "poll_interval_s",
+                 "collect_window_s")
+
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None,
                  **overrides) -> "WatchdogConfig":
@@ -98,7 +109,16 @@ class WatchdogConfig:
             elif field == "max_dumps":
                 kw[field] = int(_env_float(env, var, base.max_dumps))
             else:
-                kw[field] = _env_float(env, var, getattr(base, field))
+                val = _env_float(env, var, getattr(base, field))
+                if field in cls._POSITIVE and val <= 0:
+                    raise ValueError(
+                        f"{var}={env.get(var)!r} parses to {val:g} — a "
+                        f"non-positive value would silently break the "
+                        f"{field} stall predicate instead of disabling "
+                        f"it; set {var} to a positive number or unset "
+                        f"it to use the default "
+                        f"({getattr(base, field):g})")
+                kw[field] = val
         kw.update(overrides)
         return cls(**kw)
 

@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators import _packing
 from chainermn_tpu.utils import pvary
+from chainermn_tpu.utils.placement import local_device_put
 
 
 class _MultiNodeOptimizer:
@@ -618,7 +619,9 @@ def init_model_state(communicator, model_state):
     comm = communicator
     stacked = jax.tree.map(
         lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape), model_state)
-    return jax.device_put(
+    # identical on every rank — placement stays process-local
+    # (utils/placement.py: cross-process device_put is order-hazardous)
+    return local_device_put(
         stacked, NamedSharding(comm.mesh, P(comm.data_axes)))
 
 
@@ -634,7 +637,7 @@ def init_opt_state(communicator, optimizer, params):
         stacked = jax.tree.map(
             lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape),
             state.inner)
-        return _ZeroState(inner=jax.device_put(
+        return _ZeroState(inner=local_device_put(
             stacked, NamedSharding(comm.mesh, P(comm.data_axes))))
     if isinstance(state, _CompressedState):
         # inner replicated; EF state stacked per device (each rank owns
@@ -643,16 +646,17 @@ def init_opt_state(communicator, optimizer, params):
             lambda z: jnp.broadcast_to(z, (comm.size,) + z.shape),
             state.comp)
         return _CompressedState(
-            inner=jax.device_put(state.inner, NamedSharding(comm.mesh, P())),
-            comp=jax.device_put(
+            inner=local_device_put(state.inner,
+                                   NamedSharding(comm.mesh, P())),
+            comp=local_device_put(
                 stacked, NamedSharding(comm.mesh, P(comm.data_axes))))
     if not isinstance(state, _DoubleBufferState):
-        return jax.device_put(state, NamedSharding(comm.mesh, P()))
+        return local_device_put(state, NamedSharding(comm.mesh, P()))
     stacked_pending = jax.tree.map(
         lambda z: jnp.zeros((comm.size,) + z.shape, z.dtype), state.pending)
     return _DoubleBufferState(
-        inner=jax.device_put(state.inner, NamedSharding(comm.mesh, P())),
-        pending=jax.device_put(
+        inner=local_device_put(state.inner, NamedSharding(comm.mesh, P())),
+        pending=local_device_put(
             stacked_pending, NamedSharding(comm.mesh, P(comm.data_axes))),
-        step=jax.device_put(state.step, NamedSharding(comm.mesh, P())),
+        step=local_device_put(state.step, NamedSharding(comm.mesh, P())),
     )

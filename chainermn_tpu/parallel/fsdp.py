@@ -75,6 +75,8 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from chainermn_tpu.utils.placement import local_device_put
+
 from chainermn_tpu.communicators import _packing
 from chainermn_tpu.parallel import buckets as _buckets
 
@@ -366,14 +368,16 @@ resolve_compressor`).  ``NoCompression(wire_dtype=...)`` folds into the
     if all(c is None for c in comp_states):
         comp_out = ()
     else:
-        comp_out = jax.device_put(
+        comp_out = local_device_put(
             jax.tree.map(
                 lambda z: jnp.broadcast_to(z, (size,) + z.shape),
                 comp_states),
             sharding)
+    # every rank computes the full stacks — placement stays
+    # process-local (utils/placement.py)
     return FsdpState(
-        shards=jax.device_put(stacked, sharding),
-        inner=jax.device_put(stacked_inner, sharding),
+        shards=local_device_put(stacked, sharding),
+        inner=local_device_put(stacked_inner, sharding),
         comp=comp_out,
     ), meta
 

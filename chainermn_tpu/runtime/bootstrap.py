@@ -67,7 +67,15 @@ def install_crash_dumps(out_dir: Optional[str] = None,
             if watchdog is not None:
                 watchdog.dump_now(reason)
             else:
-                rec.dump(out_dir=out_dir, rank=rank, reason=reason)
+                # crash-time evidence stamp: ring capacity + overwrite
+                # count travel in the dump so a restart manifest can
+                # flag a truncated evidence window (the dump itself
+                # also records both; stamping here keeps the contract
+                # explicit even for pre-ring readers of `extra`)
+                rec.dump(out_dir=out_dir, rank=rank, reason=reason,
+                         extra={"crash_dump": True,
+                                "ring_capacity": int(rec.capacity),
+                                "dropped_events": int(rec.dropped_events)})
         except Exception:
             pass  # the dump path must never mask the original failure
 

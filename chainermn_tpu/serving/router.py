@@ -70,6 +70,12 @@ class Router:
         self.dispatch_log: List[Tuple[int, Hashable, int]] = []
         self.completions: List[Tuple[int, Hashable, Completion]] = []
         self._claimed: Dict[int, int] = {}  # per-replica completions seen
+        # elastic bookkeeping: what each live rid asked for (so a lost
+        # replica's in-flight requests can be replayed), which replicas
+        # are drained out of dispatch, and the work each drain stranded
+        self._requests: Dict[int, Tuple[object, int, Optional[Hashable]]] = {}
+        self._drained: set = set()
+        self._lost: Dict[int, List[int]] = {}
 
         from chainermn_tpu.observability.registry import (enabled,
                                                           get_registry)
@@ -91,6 +97,13 @@ class Router:
     def status(self) -> List[ReplicaStatus]:
         out = []
         for i, eng in enumerate(self.engines):
+            if i in self._drained:
+                # a drained replica's engine may be a dead world — it
+                # must neither be probed nor dispatched to
+                out.append(ReplicaStatus(replica=i, queue_depth=0,
+                                         active=0, free_pages=0,
+                                         num_pages=0))
+                continue
             sched = eng.scheduler
             out.append(ReplicaStatus(
                 replica=i, queue_depth=sched.queue_depth,
@@ -101,8 +114,15 @@ class Router:
 
     def _pick_replica(self, session: Optional[Hashable]) -> int:
         if session is not None and session in self._session_replica:
-            return self._session_replica[session]
-        st = self.status()
+            rep = self._session_replica[session]
+            if rep not in self._drained:
+                return rep
+            del self._session_replica[session]  # re-route the session
+        st = [s for s in self.status() if s.replica not in self._drained]
+        if not st:
+            raise RuntimeError(
+                "every replica is drained — readmit one "
+                "(Router.readmit_replica) before submitting")
         best = min(st, key=lambda s: (s.load, s.replica))
         if session is not None:
             self._session_replica[session] = best.replica
@@ -121,6 +141,7 @@ class Router:
         self._next_rid += 1
         self._rid_map[rid] = (rep, eng_rid)
         self._session_of[(rep, eng_rid)] = session
+        self._requests[rid] = (prompt, max_new_tokens, session)
         self.dispatch_log.append((rid, session, rep))
         if self._m is not None:
             self._m["dispatched"].inc(replica=str(rep))
@@ -133,7 +154,8 @@ class Router:
         return self._rid_map[rid][0]
 
     def idle(self) -> bool:
-        return all(e.idle() for e in self.engines)
+        return all(e.idle() for i, e in enumerate(self.engines)
+                   if i not in self._drained)
 
     # -- the fleet step loop -------------------------------------------------
     def _collect(self, rep: int) -> None:
@@ -148,6 +170,8 @@ class Router:
         """Step every busy replica once; returns how many stepped."""
         stepped = 0
         for i, eng in enumerate(self.engines):
+            if i in self._drained:
+                continue
             if not eng.idle():
                 eng.step()
                 self._collect(i)
@@ -166,6 +190,70 @@ class Router:
                 f"fleet still busy after {max_steps} steps: "
                 f"{[(s.replica, s.queue_depth, s.active) for s in self.status()]}")
         return self.completions[start:]
+
+    # -- elastic fleet membership --------------------------------------------
+    def drain_replica(self, rep: int) -> Dict[str, object]:
+        """Take a lost replica out of dispatch (supervisor ``on_incident``
+        hook).
+
+        Its session affinities are forgotten — the next turn of each
+        session re-routes by least load, re-prefilling on the new home —
+        and every request the replica had not completed is replayed onto
+        a surviving replica under the SAME router rid, so callers'
+        handles stay valid and at most the lost replica's in-flight
+        decode work is repeated, never dropped.  Returns a summary dict
+        (``sessions_rerouted``, ``requests_replayed``).
+        """
+        if not (0 <= rep < len(self.engines)):
+            raise ValueError(f"no replica {rep} (fleet size "
+                             f"{len(self.engines)})")
+        self._drained.add(rep)
+
+        done = {(r, c.rid) for r, _s, c in self.completions if r == rep}
+        stranded = [rid for rid, (r, erid) in self._rid_map.items()
+                    if r == rep and (r, erid) not in done]
+        self._lost[rep] = list(stranded)
+
+        moved_sessions = [s for s, r in self._session_replica.items()
+                          if r == rep]
+        for s in moved_sessions:
+            del self._session_replica[s]
+
+        replayed = 0
+        for rid in stranded:
+            prompt, max_new, session = self._requests[rid]
+            old_rep, old_erid = self._rid_map[rid]
+            self._session_of.pop((old_rep, old_erid), None)
+            new_rep = self._pick_replica(session)
+            new_erid = self.engines[new_rep].submit(prompt, max_new)
+            self._rid_map[rid] = (new_rep, new_erid)
+            self._session_of[(new_rep, new_erid)] = session
+            self.dispatch_log.append((rid, session, new_rep))
+            replayed += 1
+            if self._m is not None:
+                self._m["dispatched"].inc(replica=str(new_rep))
+        return {"replica": rep, "sessions_rerouted": len(moved_sessions),
+                "requests_replayed": replayed}
+
+    def readmit_replica(self, rep: int, engine=None) -> None:
+        """Return a drained replica to dispatch (supervisor
+        ``on_recovered`` hook).  Pass ``engine`` when the restarted world
+        came back as a fresh :class:`InferenceEngine` — its completion
+        list starts empty, so the claim cursor resets with it.  New
+        first-turn sessions may now land on it; replayed requests stay
+        where the drain put them.
+        """
+        if rep not in self._drained:
+            raise ValueError(f"replica {rep} is not drained")
+        if engine is not None:
+            self.engines[rep] = engine
+            self._claimed[rep] = 0
+        self._drained.discard(rep)
+        self._lost.pop(rep, None)
+
+    @property
+    def drained(self) -> frozenset:
+        return frozenset(self._drained)
 
     # -- fleet weight distribution -------------------------------------------
     @staticmethod

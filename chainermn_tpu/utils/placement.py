@@ -1,0 +1,50 @@
+"""Host-local placement onto multi-controller meshes.
+
+``jax.device_put`` onto a sharding that spans OTHER processes issues
+cross-host point-to-point transfers whose wire order is not coordinated
+between ranks.  Two ranks placing several leaves concurrently (a resume,
+a bcast, an optimizer init) can interleave those transfers into a gloo
+size-mismatch abort — observed on the CPU collectives backend as
+
+    gloo::EnforceNotMet ... op.preamble.length <= op.nbytes. A vs B
+
+during elastic restarts, where A and B are two different leaves' shard
+byte counts.  Every call site in this codebase that places host values
+into a mesh-wide sharding already holds the bytes its own devices need
+(replicated params after a control-plane ``bcast_obj``, a restored
+checkpoint read from the rank's own file, identically-computed init
+state), so the global array can be assembled purely from addressable
+shards — no network, no ordering hazard.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["local_device_put"]
+
+
+def local_device_put(x, sharding):
+    """``jax.device_put(x, sharding)`` that never crosses processes.
+
+    When ``sharding`` is fully addressable (single-controller worlds,
+    sub-meshes owned by this process) this IS ``jax.device_put``.  When
+    it spans other processes, each leaf's global array is built from the
+    host-local value via ``jax.make_array_from_callback`` — valid
+    because the caller guarantees this process already holds the data
+    for its own shards (replicated values, or per-device stacks computed
+    identically on every rank).
+
+    Pytree-aware; leaves must be host-materializable on this process
+    (numpy arrays or fully-addressable jax arrays).
+    """
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+
+    def _leaf(v):
+        arr = np.asarray(v)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    return jax.tree.map(_leaf, x)

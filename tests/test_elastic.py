@@ -1,0 +1,707 @@
+"""Elastic runtime tests — peer-safe checkpoint GC, corrupted-generation
+fallback, sidecar refusal golden strings, watchdog env round-trip,
+crash-dump ring stamps, the supervisor's communicator-free generation
+scan, the async checkpoint backend, restart manifests, elastic world
+resize (8->4 and 4->8), and the serving Router's drain/readmit hooks
+(chainermn_tpu/elastic/, docs/elasticity.md).
+
+The chaos SIGKILL path (supervisor + watchdog + auto-restart across real
+processes) runs in tools/elastic_smoke.py and is gated by
+``perf_gate --elastic`` over the committed ELASTIC_r19.json artifact;
+here we pin the unit seams that harness composes.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.extensions.checkpoint import (
+    _COMPRESSION_META_KEY, _FSDP_META_KEY, _PLAN_TABLE_META_KEY,
+    create_multi_node_checkpointer)
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("naive", intra_size=4)
+
+
+def _state(v, n=4):
+    return {"w": np.full(n, float(v), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Satellite: peer-safe GC
+# ---------------------------------------------------------------------------
+
+class TestGcPeerSafety:
+    def test_gc_never_deletes_generation_a_peer_needs(self, comm,
+                                                      tmp_path):
+        """A lagging peer's newest shared generation is never collected:
+        generations >= the newest world-complete one survive GC even
+        when they fall past ``keep``."""
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="snap", keep=2)
+        # a crashed peer (rank 1) stalled at generation 20 — its file is
+        # the only evidence it exists; deleting rank 0's copy of gen 20
+        # would leave the world with no consistent generation at all
+        peer = tmp_path / "snap.20.rank1.npz"
+        np.savez(str(peer), leaf_0=np.zeros(1))
+        for g in (10, 20, 30, 40):
+            ckpt.save(_state(g), g)
+        gens = ckpt._local_generations()
+        # 10 was strictly older than the newest complete generation (20)
+        # and got collected; 20 is pinned by the peer, 30/40 by keep=2
+        assert gens == [20, 30, 40]
+        assert peer.exists()
+
+    def test_gc_plain_keep_policy_without_peers(self, comm, tmp_path):
+        """On a per-host directory (only our own files visible) GC
+        degrades to keep-newest."""
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="snap", keep=2)
+        for g in (10, 20, 30, 40):
+            ckpt.save(_state(g), g)
+        assert ckpt._local_generations() == [30, 40]
+
+    def test_stale_larger_world_rank_does_not_pin(self, comm, tmp_path):
+        """Files from ranks beyond comm.size (a pre-resize world) are
+        ignored by the completeness vote — they must not pin garbage."""
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="snap", keep=2)
+        np.savez(str(tmp_path / "snap.10.rank99.npz"), leaf_0=np.zeros(1))
+        for g in (10, 20, 30, 40):
+            ckpt.save(_state(g), g)
+        assert ckpt._local_generations() == [30, 40]
+
+
+# ---------------------------------------------------------------------------
+# Satellite: corrupted-partial-generation fallback
+# ---------------------------------------------------------------------------
+
+class TestCorruptedGenerationFallback:
+    def test_truncated_newest_falls_back(self, comm, tmp_path):
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="c", keep=0)
+        for g in (1, 2, 3, 4):
+            ckpt.save(_state(g), g)
+        fn = ckpt._file(4)
+        with open(fn, "r+b") as f:
+            f.truncate(os.path.getsize(fn) // 2)
+        # the torn npz is CRC-excluded before the vote
+        assert ckpt.latest_consistent_generation() == 3
+        restored, it = ckpt.resume(_state(0))
+        assert it == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      _state(3)["w"])
+
+    def test_garbage_file_excluded(self, comm, tmp_path):
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="c", keep=0)
+        ckpt.save(_state(1), 1)
+        (tmp_path / "c.2.rank0.npz").write_bytes(b"not a zip at all")
+        assert ckpt.latest_consistent_generation() == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: _validate_restore golden refusal strings
+# ---------------------------------------------------------------------------
+
+def _arrays(leaves, **meta):
+    out = {f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    for k, v in meta.items():
+        out[k] = np.array(json.dumps(v))
+    return out
+
+
+class TestValidateRestoreGoldenStrings:
+    """Every sidecar refusal fires with its exact message — the
+    operator-facing contract (each names the mismatch AND the fix)."""
+
+    @pytest.fixture
+    def ckpt(self, comm, tmp_path):
+        return create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="g", keep=0)
+
+    @pytest.fixture
+    def plain(self):
+        state = {"w": np.zeros(2, np.float32)}
+        return state, jax.tree.leaves(state)
+
+    @pytest.fixture(autouse=True)
+    def _no_plan_table(self, monkeypatch):
+        import chainermn_tpu.planner.online as online
+        monkeypatch.setattr(online, "active_plan_table_meta",
+                            lambda: None)
+
+    def _patch_fsdp(self, monkeypatch, layout):
+        import chainermn_tpu.parallel.fsdp as fsdp_mod
+        monkeypatch.setattr(fsdp_mod, "fsdp_layout", lambda s: layout)
+
+    def _patch_comp(self, monkeypatch, layout):
+        import chainermn_tpu.compression as comp_mod
+        monkeypatch.setattr(comp_mod, "compression_layout",
+                            lambda s: layout)
+
+    def test_fsdp_into_unsharded(self, ckpt, plain):
+        state, leaves = plain
+        arrays = _arrays(leaves,
+                         **{_FSDP_META_KEY: {"world_size": 8}})
+        with pytest.raises(ValueError,
+                           match="holds an FSDP-sharded state"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_world_size_mismatch(self, ckpt, plain, comm, monkeypatch):
+        state, leaves = plain
+        self._patch_fsdp(monkeypatch, {"world_size": comm.size,
+                                       "num_buckets": 1,
+                                       "shard_lens": [4]})
+        arrays = _arrays(leaves,
+                         **{_FSDP_META_KEY: {"world_size": 999}})
+        with pytest.raises(ValueError,
+                           match="was saved with FSDP world_size=999"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_num_buckets_mismatch(self, ckpt, plain, comm, monkeypatch):
+        state, leaves = plain
+        self._patch_fsdp(monkeypatch, {"world_size": comm.size,
+                                       "num_buckets": 1,
+                                       "shard_lens": [4]})
+        arrays = _arrays(leaves, **{_FSDP_META_KEY: {
+            "world_size": comm.size, "num_buckets": 2,
+            "shard_lens": [4]}})
+        with pytest.raises(ValueError,
+                           match="num_buckets=2 but the live"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_shard_layout_mismatch(self, ckpt, plain, comm, monkeypatch):
+        state, leaves = plain
+        self._patch_fsdp(monkeypatch, {"world_size": comm.size,
+                                       "num_buckets": 1,
+                                       "shard_lens": [4]})
+        arrays = _arrays(leaves, **{_FSDP_META_KEY: {
+            "world_size": comm.size, "num_buckets": 1,
+            "shard_lens": [8]}})
+        with pytest.raises(ValueError,
+                           match="shard layout .* does not match the "
+                                 "live"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_ef_state_into_uncompressed(self, ckpt, plain):
+        state, leaves = plain
+        arrays = _arrays(leaves, **{_COMPRESSION_META_KEY: {
+            "specs": ["int8"]}})
+        with pytest.raises(ValueError,
+                           match="carries error-feedback compression "
+                                 "state"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_uncompressed_into_ef_target(self, ckpt, plain, monkeypatch):
+        state, leaves = plain
+        self._patch_comp(monkeypatch, {"specs": ["int8"]})
+        arrays = _arrays(leaves)
+        with pytest.raises(ValueError,
+                           match="has no compression state but the "
+                                 "resume target expects EF state"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_compression_config_mismatch(self, ckpt, plain, monkeypatch):
+        state, leaves = plain
+        self._patch_comp(monkeypatch, {"specs": ["int8"]})
+        arrays = _arrays(leaves, **{_COMPRESSION_META_KEY: {
+            "specs": ["fp8"]}})
+        with pytest.raises(ValueError,
+                           match="compression config .* does not match "
+                                 "the live config"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_plan_table_missing(self, ckpt, plain):
+        state, leaves = plain
+        arrays = _arrays(leaves, **{_PLAN_TABLE_META_KEY: {
+            "table_hash": "abc", "swap_step": 3}})
+        with pytest.raises(ValueError,
+                           match="saved after an online plan-table "
+                                 "hot-swap"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_plan_table_hash_mismatch(self, ckpt, plain, monkeypatch):
+        import chainermn_tpu.planner.online as online
+        monkeypatch.setattr(online, "active_plan_table_meta",
+                            lambda: {"table_hash": "def",
+                                     "swap_step": 9})
+        state, leaves = plain
+        arrays = _arrays(leaves, **{_PLAN_TABLE_META_KEY: {
+            "table_hash": "abc", "swap_step": 3}})
+        with pytest.raises(ValueError, match="pins plan table abc"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_leaf_count_mismatch(self, ckpt, plain):
+        state, leaves = plain
+        arrays = _arrays(leaves + [np.zeros(1)])
+        with pytest.raises(ValueError,
+                           match="has 2 leaves but the resume target "
+                                 "has 1"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+    def test_leaf_shape_mismatch(self, ckpt, plain):
+        state, leaves = plain
+        arrays = _arrays([np.zeros(3, np.float32)])
+        with pytest.raises(ValueError,
+                           match=r"leaf_0 has shape \(3,\) but the "
+                                 r"resume target expects \(2,\)"):
+            ckpt._validate_restore(arrays, state, leaves, 7)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: watchdog env round-trip + loud bad-knob errors
+# ---------------------------------------------------------------------------
+
+class TestWatchdogEnvConfig:
+    def test_round_trip(self):
+        from chainermn_tpu.observability.watchdog import WatchdogConfig
+        cfg = WatchdogConfig(deadline_s=12.5, step_stall_factor=3.0,
+                             heartbeat_interval_s=0.5,
+                             heartbeat_timeout_s=2.0,
+                             poll_interval_s=0.25,
+                             collect_window_s=1.5, max_dumps=5,
+                             out_dir="/tmp/flight")
+        assert WatchdogConfig.from_env(env=cfg.to_env()) == cfg
+
+    def test_defaults_round_trip(self):
+        from chainermn_tpu.observability.watchdog import WatchdogConfig
+        cfg = WatchdogConfig()
+        assert WatchdogConfig.from_env(env=cfg.to_env()) == cfg
+
+    @pytest.mark.parametrize("var,val", [
+        ("CHAINERMN_TPU_WATCHDOG_DEADLINE", "-2.5"),
+        ("CHAINERMN_TPU_WATCHDOG_HB_TIMEOUT", "0"),
+        ("CHAINERMN_TPU_WATCHDOG_POLL", "0"),
+        ("CHAINERMN_TPU_WATCHDOG_STEP_K", "-1"),
+        ("CHAINERMN_TPU_WATCHDOG_COLLECT", "0"),
+    ])
+    def test_nonpositive_timeout_names_the_knob(self, var, val):
+        from chainermn_tpu.observability.watchdog import WatchdogConfig
+        with pytest.raises(ValueError, match=var):
+            WatchdogConfig.from_env(env={var: val})
+
+    def test_heartbeat_interval_zero_is_the_off_switch(self):
+        from chainermn_tpu.observability.watchdog import WatchdogConfig
+        cfg = WatchdogConfig.from_env(
+            env={"CHAINERMN_TPU_WATCHDOG_HEARTBEAT": "0"})
+        assert cfg.heartbeat_interval_s == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: crash-time dumps stamp ring capacity + dropped events
+# ---------------------------------------------------------------------------
+
+class TestCrashDumpRingStamps:
+    def test_excepthook_dump_carries_ring_stamps(self, tmp_path):
+        from chainermn_tpu.observability import flight_recorder as fl
+        from chainermn_tpu.runtime.bootstrap import install_crash_dumps
+
+        rec = fl.FlightRecorder(capacity=4)
+        for i in range(9):  # overflow the ring: 5 events dropped
+            rec.record("noise", i=i)
+        old_hook = sys.excepthook
+        sys.excepthook = lambda *a: None  # keep pytest's hook quiet
+        try:
+            uninstall = install_crash_dumps(out_dir=str(tmp_path),
+                                            rank=3, recorder=rec,
+                                            force=True)
+            assert uninstall is not None
+            sys.excepthook(ValueError, ValueError("boom"), None)
+            uninstall()
+        finally:
+            sys.excepthook = old_hook
+        with open(tmp_path / "flight_3.json") as f:
+            doc = json.load(f)
+        assert doc["reason"].startswith("unhandled_exception:ValueError")
+        assert doc["crash_dump"] is True
+        assert doc["ring_capacity"] == 4
+        assert doc["dropped_events"] == 5
+        assert doc["evidence_truncated"] is True
+
+    def test_sigterm_dump(self, tmp_path):
+        import signal
+
+        from chainermn_tpu.observability import flight_recorder as fl
+        from chainermn_tpu.runtime.bootstrap import install_crash_dumps
+
+        rec = fl.FlightRecorder(capacity=8)
+        rec.record("work")
+        old = signal.signal(signal.SIGTERM, lambda *a: None)
+        try:
+            uninstall = install_crash_dumps(out_dir=str(tmp_path),
+                                            rank=1, recorder=rec,
+                                            force=True,
+                                            signals=[signal.SIGTERM])
+            handler = signal.getsignal(signal.SIGTERM)
+            # dump, then re-deliver to the prior (no-op) disposition
+            handler(signal.SIGTERM, None)
+            uninstall()
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        with open(tmp_path / "flight_1.json") as f:
+            doc = json.load(f)
+        assert "signal" in doc["reason"]
+        assert doc["ring_capacity"] == 8
+        assert doc["dropped_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor-side generation scan (communicator-free)
+# ---------------------------------------------------------------------------
+
+class TestScanLatestGeneration:
+    def _put(self, d, gen, rank, garbage=False):
+        fn = d / f"snap.{gen}.rank{rank}.npz"
+        if garbage:
+            fn.write_bytes(b"torn")
+        else:
+            np.savez(str(fn), a=np.zeros(1))
+
+    def test_n_ranks_pins_completeness(self, tmp_path):
+        from chainermn_tpu.elastic.supervisor import scan_latest_generation
+        for g, r in [(4, 0), (4, 1), (5, 0), (5, 1), (6, 0)]:
+            self._put(tmp_path, g, r)
+        # without n_ranks the lone rank0 file at gen 6 looks complete
+        assert scan_latest_generation(str(tmp_path), "snap") == 6
+        # the supervisor pins the next attempt's world size
+        assert scan_latest_generation(str(tmp_path), "snap",
+                                      n_ranks=2) == 5
+        assert scan_latest_generation(str(tmp_path), "snap",
+                                      n_ranks=1) == 6
+
+    def test_corrupt_rank_file_degrades(self, tmp_path):
+        from chainermn_tpu.elastic.supervisor import scan_latest_generation
+        for g, r in [(4, 0), (4, 1), (5, 0)]:
+            self._put(tmp_path, g, r)
+        self._put(tmp_path, 5, 1, garbage=True)
+        assert scan_latest_generation(str(tmp_path), "snap",
+                                      n_ranks=2) == 4
+
+    def test_stale_larger_world_files_are_supersets(self, tmp_path):
+        from chainermn_tpu.elastic.supervisor import scan_latest_generation
+        # generation saved at world 4, resuming at world 2: extra rank
+        # files must not veto completeness
+        for r in range(4):
+            self._put(tmp_path, 7, r)
+        assert scan_latest_generation(str(tmp_path), "snap",
+                                      n_ranks=2) == 7
+
+    def test_empty_and_missing(self, tmp_path):
+        from chainermn_tpu.elastic.supervisor import scan_latest_generation
+        assert scan_latest_generation(str(tmp_path), "snap") is None
+        assert scan_latest_generation(
+            str(tmp_path / "nope"), "snap") is None
+
+
+# ---------------------------------------------------------------------------
+# Async checkpoint backend
+# ---------------------------------------------------------------------------
+
+class TestAsyncCheckpointer:
+    def test_save_resume_round_trip(self, comm, tmp_path):
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="as", keep=0,
+                                              backend="async")
+        for g in range(4):
+            ckpt.save(_state(g), g)
+        assert ckpt.drain(timeout=30.0)
+        assert len(ckpt.stall_ms) == 4
+        assert all(s >= 0.0 for s in ckpt.stall_ms)
+        assert ckpt.last_stall_ms == ckpt.stall_ms[-1]
+        assert ckpt.latest_consistent_generation() == 3
+        restored, it = ckpt.resume(_state(0))
+        assert it == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      _state(3)["w"])
+
+    def test_write_barrier_before_gc(self, comm, tmp_path):
+        """keep=2 GC runs on the persist thread but only after the
+        superseding generation's atomic publish."""
+        ckpt = create_multi_node_checkpointer(comm, str(tmp_path),
+                                              name="as", keep=2,
+                                              backend="async")
+        for g in range(5):
+            ckpt.save(_state(g), g)
+        assert ckpt.drain(timeout=30.0)
+        assert ckpt._inner._local_generations() == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# Restart manifests
+# ---------------------------------------------------------------------------
+
+class TestRestartManifest:
+    def _dump(self, d, rank, dropped=0, capacity=256, events=None):
+        doc = {"kind": "flight_dump", "schema": "flight_dump/v1",
+               "rank": rank, "ts": 1.0, "reason": "watchdog",
+               "dropped_events": dropped, "ring_capacity": capacity,
+               "evidence_truncated": bool(dropped),
+               "collective_state": {}, "events": events or [],
+               "threads": []}
+        with open(os.path.join(d, f"flight_{rank}.json"), "w") as f:
+            json.dump(doc, f)
+
+    def test_manifest_embeds_dumps_and_evidence(self, tmp_path):
+        from chainermn_tpu.elastic.manifest import (
+            build_restart_manifest, write_restart_manifest)
+        self._dump(str(tmp_path), 1, dropped=7, capacity=128,
+                   events=[{"kind": "collective_begin", "seq": 0,
+                            "ts": 1.0, "mono": 1.0, "op": "allreduce"}])
+        doc = build_restart_manifest(
+            incident=0, reason="rank 1 exited -9",
+            dump_dir=str(tmp_path), exit_codes={0: None, 1: -9},
+            resume_generation=6, attempt=0, world_before=2,
+            world_after=2,
+            watchdog_config={"deadline_s": 20.0},
+            extra={"stderr_tails": {"1": "killed"}})
+        assert doc["schema"] == "restart_manifest/v1"
+        assert doc["exit_codes"] == {"0": None, "1": -9}
+        assert doc["world"] == {"before": 2, "after": 2}
+        assert doc["resume"]["generation"] == 6
+        # the survivor's dump rides along verbatim, ring stamps intact
+        emb = doc["flight_dumps"]["1"]
+        assert emb["dropped_events"] == 7
+        assert emb["ring_capacity"] == 128
+        # evidence-truncation stamp (PR 16 convention at crash time)
+        assert doc["evidence"]["truncated"] is True
+        assert doc["evidence"]["per_rank"]["1"]["dropped_events"] == 7
+        assert doc["attribution"] is not None
+        assert doc["watchdog"] == {"deadline_s": 20.0}
+        assert doc["stderr_tails"] == {"1": "killed"}
+        path = write_restart_manifest(doc, str(tmp_path))
+        assert path.endswith("restart_manifest_0.json")
+        with open(path) as f:
+            assert json.load(f)["incident"] == 0
+
+    def test_torn_dump_skipped(self, tmp_path):
+        from chainermn_tpu.elastic.manifest import load_flight_dumps
+        self._dump(str(tmp_path), 0)
+        (tmp_path / "flight_1.json").write_text("{torn")
+        dumps = load_flight_dumps(str(tmp_path))
+        assert sorted(dumps) == [0]
+
+    def test_resize_section(self, tmp_path):
+        from chainermn_tpu.elastic.manifest import build_restart_manifest
+        doc = build_restart_manifest(
+            incident=1, reason="resize", dump_dir=str(tmp_path),
+            exit_codes={}, resume_generation=None, attempt=2,
+            world_before=8, world_after=4,
+            resize={"from_world": 8, "to_world": 4})
+        assert doc["resize"]["to_world"] == 4
+        assert doc["evidence"]["truncated"] is False
+        assert doc["attribution"] is None
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: elastic world resize
+# ---------------------------------------------------------------------------
+
+def _resize_problem(seed=0):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x)
+
+    model = MLP()
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(64, 8).astype(np.float32)
+    ys = (xs @ rng.randn(8, 4)).astype(np.float32)
+    params = model.init(jax.random.key(seed), xs[:1])
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((model.apply(p, x) - y) ** 2)
+
+    return params, loss_fn, (xs, ys)
+
+
+def _sub_comm(n):
+    from jax.sharding import Mesh
+    return chainermn_tpu.create_communicator(
+        "flat", mesh=Mesh(np.array(jax.devices()[:n]), ("data",)))
+
+
+def _train_and_save(comm, path, steps=2, **fsdp_kw):
+    from chainermn_tpu.parallel.fsdp import (
+        fsdp_full_params, fsdp_init, make_fsdp_train_step)
+    from chainermn_tpu.training import put_global_batch
+
+    params, loss_fn, (xs, ys) = _resize_problem()
+    n = comm.size * 4
+    batch = put_global_batch(comm, (xs[:n], ys[:n]))
+    state, meta = fsdp_init(comm, params, optax.adam(0.01), **fsdp_kw)
+    step = make_fsdp_train_step(comm, loss_fn, optax.adam(0.01), meta,
+                                donate=False)
+    for _ in range(steps):
+        state, _loss = step(state, batch)
+    ckpt = create_multi_node_checkpointer(comm, path, name="rs", keep=0)
+    ckpt.save({"fsdp": state}, 5)
+    return fsdp_full_params(state, meta), loss_fn, (xs, ys)
+
+
+def _resume_into(comm, path, **fsdp_kw):
+    from chainermn_tpu.elastic.resize import resume_resized
+    from chainermn_tpu.parallel.fsdp import fsdp_full_params, fsdp_init
+
+    params, _, _ = _resize_problem()
+    state, meta = fsdp_init(comm, params, optax.adam(0.01), **fsdp_kw)
+    ckpt = create_multi_node_checkpointer(comm, path, name="rs", keep=0)
+    new_state, gen, report = resume_resized(ckpt, {"fsdp": state})
+    return fsdp_full_params(new_state["fsdp"], meta), gen, report
+
+
+class TestElasticResize:
+    def _assert_parity(self, full_a, full_b, loss_fn, data):
+        for a, b in zip(jax.tree.leaves(full_a), jax.tree.leaves(full_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(loss_fn(full_a, data)),
+                                   float(loss_fn(full_b, data)),
+                                   rtol=1e-5)
+
+    def test_shrink_8_to_4(self, tmp_path):
+        comm8 = chainermn_tpu.create_communicator("flat")
+        assert comm8.size == 8
+        ref_full, loss_fn, data = _train_and_save(comm8, str(tmp_path),
+                                                  num_buckets=2)
+        comm4 = _sub_comm(4)
+        new_full, gen, report = _resume_into(comm4, str(tmp_path),
+                                             num_buckets=2)
+        assert gen == 5
+        assert report["resized"] is True
+        assert report["from_world"] == 8 and report["to_world"] == 4
+        assert report["resharded_leaves"] > 0
+        self._assert_parity(ref_full, new_full, loss_fn, data)
+
+    def test_grow_4_to_8(self, tmp_path):
+        comm4 = _sub_comm(4)
+        ref_full, loss_fn, data = _train_and_save(comm4, str(tmp_path),
+                                                  num_buckets=2)
+        comm8 = chainermn_tpu.create_communicator("flat")
+        new_full, gen, report = _resume_into(comm8, str(tmp_path),
+                                             num_buckets=2)
+        assert gen == 5
+        assert report["resized"] is True
+        assert report["from_world"] == 4 and report["to_world"] == 8
+        assert report["resharded_leaves"] > 0
+        self._assert_parity(ref_full, new_full, loss_fn, data)
+
+    def test_same_world_falls_through_to_plain_resume(self, tmp_path):
+        comm8 = chainermn_tpu.create_communicator("flat")
+        ref_full, loss_fn, data = _train_and_save(comm8, str(tmp_path))
+        new_full, gen, report = _resume_into(comm8, str(tmp_path))
+        assert gen == 5
+        assert report["resized"] is False
+        self._assert_parity(ref_full, new_full, loss_fn, data)
+
+    def test_resize_rekeys_compression_state(self, tmp_path):
+        """EF residuals are bound to the old world's shards: the resize
+        re-keys them (fresh zeros) and reports the dropped norm."""
+        comm8 = chainermn_tpu.create_communicator("flat")
+        _train_and_save(comm8, str(tmp_path), num_buckets=2,
+                        bucket_compressors="int8")
+        comm4 = _sub_comm(4)
+        _full, gen, report = _resume_into(comm4, str(tmp_path),
+                                          num_buckets=2,
+                                          bucket_compressors="int8")
+        assert gen == 5
+        assert report["rekeyed_comp_states"] >= 1
+        assert report["dropped_ef_norm"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Serving Router drain / readmit (lost-replica sessions survive)
+# ---------------------------------------------------------------------------
+
+class TestRouterDrainReadmit:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        from chainermn_tpu.models.transformer import TransformerLM
+        model = TransformerLM(vocab=61, d_model=32, n_layers=2,
+                              n_heads=4, max_len=128,
+                              attention_impl="xla", n_kv_heads=2)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))
+        return model, params
+
+    def _fleet(self, tiny, n=2):
+        from chainermn_tpu.serving import (InferenceEngine, Router,
+                                           ServingConfig)
+        model, params = tiny
+        cfg = ServingConfig(page_size=4, num_pages=32, max_seqs=2,
+                            chunk_tokens=8, max_pages_per_seq=8,
+                            prefix_cache=True)
+        return Router([InferenceEngine(model, params, cfg)
+                       for _ in range(n)])
+
+    def _prompts(self, sizes, seed=0):
+        rng = np.random.default_rng(seed)
+        return [list(map(int, rng.integers(1, 61, size=s)))
+                for s in sizes]
+
+    def test_drain_replays_and_reroutes(self, tiny):
+        router = self._fleet(tiny)
+        prompts = self._prompts((11, 9, 13, 7))
+        sessions = ["a", "b", "c", "d"]
+        for p, s in zip(prompts, sessions):
+            router.submit(p, 4, session=s)
+        router.run_until_idle()
+        # second turns, then kill one replica mid-decode
+        rids = [router.submit(p + [5, 6], 4, session=s)
+                for p, s in zip(prompts, sessions)]
+        router.step()
+        lost = router.replica_of(rids[0])
+        n_before = len(router.completions)
+        info = router.drain_replica(lost)
+        assert router.drained == frozenset({lost})
+        assert info["sessions_rerouted"] >= 1
+        assert info["requests_replayed"] >= 1
+        router.run_until_idle()
+        # every second-turn request completed despite the loss — the
+        # stranded ones were replayed under the same router rids
+        assert len(router.completions) - n_before >= len(rids)
+        done_reps = {router.replica_of(r) for r in rids}
+        assert lost not in done_reps or len(done_reps) == 1
+
+    def test_drained_replica_gets_no_new_work(self, tiny):
+        router = self._fleet(tiny)
+        router.drain_replica(0)
+        rid = router.submit(self._prompts((9,))[0], 3, session="x")
+        assert router.replica_of(rid) == 1
+        router.run_until_idle()
+        assert len(router.completions) == 1
+
+    def test_all_drained_raises(self, tiny):
+        router = self._fleet(tiny)
+        router.drain_replica(0)
+        router.drain_replica(1)
+        with pytest.raises(RuntimeError, match="every replica is "
+                                               "drained"):
+            router.submit(self._prompts((5,))[0], 2)
+
+    def test_readmit_restores_dispatch(self, tiny):
+        router = self._fleet(tiny)
+        router.drain_replica(0)
+        router.readmit_replica(0)
+        assert router.drained == frozenset()
+        with pytest.raises(ValueError, match="not drained"):
+            router.readmit_replica(0)
+
+    def test_drain_unknown_replica_raises(self, tiny):
+        router = self._fleet(tiny)
+        with pytest.raises(ValueError, match="no replica 5"):
+            router.drain_replica(5)

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Elastic smoke — the ISSUE-19 acceptance chaos test, runnable anywhere.
+
+Two legs, one ``elastic_smoke/v1`` artifact:
+
+* **Async checkpoint A/B** (in-process, 8-way CPU mesh): save the same
+  multi-MB state through the sync ``npz`` backend and the ``async``
+  backend.  The sync save measurably stalls the step loop (full npz
+  write on the step boundary); the async save must keep the on-step
+  stall to the snapshot (device->host) cost while the persist thread
+  writes in the background.  ``async_ckpt.stall_ms`` feeds the perf
+  gate's ``async_ckpt_stall_ms`` budget (direction: lower).
+
+* **Chaos** (2-controller CPU-mesh world under the elastic supervisor):
+  train a deterministic MNIST-shaped MLP with per-step checkpoints,
+  SIGKILL one controller mid-run — no cleanup, the preemption model —
+  and require that the supervisor (a) harvests the survivor's
+  watchdog/crash flight dump, (b) writes a ``restart_manifest/v1``
+  embedding the dump and an attribution report, and (c) relaunches a
+  world that resumes from the newest consistent generation with at most
+  ONE step of work lost (``chaos.lost_steps`` feeds the
+  ``elastic_resume_lost_steps`` budget), reproducing the uninterrupted
+  run's loss trajectory within tolerance.
+
+Exits nonzero on any violation — the multichip_day1.sh ELASTIC leg runs
+this and ``perf_gate --budgets`` reads the committed artifact.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from chainermn_tpu.utils.cpu_mesh import ensure_cpu_mesh  # noqa: E402
+
+LOSS_TOLERANCE = 1e-4   # resumed trajectory vs uninterrupted, per step
+MAX_LOST_STEPS = 1      # the "<1 step of work lost" acceptance bound
+
+_WORKER = r"""
+import json, os, signal, sys
+os.environ["CHAINERMN_TPU_OBSERVABILITY"] = "1"
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+import chainermn_tpu
+
+chainermn_tpu.init_distributed(local_device_count=4)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from chainermn_tpu.extensions.checkpoint import create_multi_node_checkpointer
+from chainermn_tpu.models import MLP
+from chainermn_tpu.observability import start_watchdog
+from chainermn_tpu.optimizers import init_opt_state, make_train_step
+from chainermn_tpu.training import put_global_batch
+
+steps = int(os.environ["ELASTIC_SMOKE_STEPS"])
+ckpt_dir = os.environ["ELASTIC_SMOKE_CKPT"]
+kill_step = int(os.environ.get("ELASTIC_SMOKE_KILL_STEP", "-1"))
+kill_rank = int(os.environ.get("ELASTIC_SMOKE_KILL_RANK", "1"))
+attempt = int(os.environ.get("CHAINERMN_TPU_ELASTIC_ATTEMPT", "0"))
+
+comm = chainermn_tpu.create_communicator("hierarchical")
+wd = start_watchdog(
+    control_plane=getattr(comm, "_cp", None),
+    out_dir=os.environ.get("CHAINERMN_TPU_FLIGHT_DIR", "."))
+
+model = MLP(n_units=64, n_out=10)
+params = model.init(jax.random.key(0), jnp.zeros((1, 784)))["params"]
+params = comm.bcast_data(params)
+optimizer = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
+opt_state = init_opt_state(comm, optimizer, params)
+
+def loss_fn(p, batch):
+    x, y = batch
+    logits = model.apply({"params": p}, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+step = make_train_step(comm, loss_fn, optimizer, donate=False)
+
+ckpt = create_multi_node_checkpointer(comm, ckpt_dir, name="chaos", keep=4)
+state, gen = ckpt.resume({"params": params, "opt_state": opt_state})
+params, opt_state = state["params"], state["opt_state"]
+start = 0 if gen is None else gen + 1
+# resume decision on stderr: a failed attempt's view lands in the
+# restart manifest's stderr_tails, so a desync is diagnosable post-hoc
+print(f"elastic_smoke rank{comm.rank} attempt={attempt} "
+      f"resumed_from={gen} start={start}", file=sys.stderr, flush=True)
+
+def batch_for(t):
+    # per-STEP seed, no rank term: every controller holds the same
+    # global batch, so baseline and chaos runs see identical data at
+    # step t no matter which attempt executes it
+    rng = np.random.default_rng(10_000 + t)
+    x = rng.standard_normal((64, 784)).astype(np.float32)
+    y = (rng.random(64) * 10).astype(np.int32)
+    return put_global_batch(comm, (x, y))
+
+losses = {}
+for t in range(start, steps):
+    params, opt_state, loss = step(params, opt_state, batch_for(t))
+    losses[t] = float(loss)
+    if attempt == 0 and t == kill_step and comm.rank == kill_rank:
+        # preemption model: the computed-but-unsaved step t dies with
+        # the process — at most ONE step of work to redo after resume
+        os.kill(os.getpid(), signal.SIGKILL)
+    ckpt.save({"params": params, "opt_state": opt_state}, t)
+ckpt.finalize()
+if wd is not None:
+    wd.stop()
+print("RESULT " + json.dumps({
+    "rank": comm.rank, "resumed_from": gen, "start": start,
+    "losses": {str(k): v for k, v in losses.items()}}))
+"""
+
+
+# ---- async checkpoint A/B ---------------------------------------------------
+
+def run_async_ab(n_saves: int = 6) -> dict:
+    import numpy as np
+
+    import chainermn_tpu
+    from chainermn_tpu.extensions.checkpoint import \
+        create_multi_node_checkpointer
+
+    comm = chainermn_tpu.create_communicator("flat")
+    rng = np.random.default_rng(0)
+    # a few MB of state so the sync npz write is a measurable stall
+    state = {f"w{i}": rng.standard_normal((512, 512)).astype(np.float32)
+             for i in range(8)}
+
+    root = tempfile.mkdtemp(prefix="elastic_ab_")
+    try:
+        sync = create_multi_node_checkpointer(
+            comm, os.path.join(root, "sync"), name="ab", keep=2,
+            backend="npz")
+        sync_ms = []
+        for i in range(n_saves):
+            t0 = time.perf_counter()
+            sync.save(state, i)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        sync.finalize()
+
+        async_ = create_multi_node_checkpointer(
+            comm, os.path.join(root, "async"), name="ab", keep=2,
+            backend="async")
+        for i in range(n_saves):
+            async_.save(state, i)
+            time.sleep(0.01)  # the "step compute" the persist hides under
+        async_.drain()
+        resumable = async_.latest_consistent_generation()
+        async_.finalize()
+        stall_ms = list(async_.stall_ms)
+        persist_ms = list(async_.persist_ms)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    med = sorted(stall_ms)[len(stall_ms) // 2]
+    med_sync = sorted(sync_ms)[len(sync_ms) // 2]
+    return {
+        "n_saves": n_saves,
+        "stall_ms": round(med, 3),
+        "sync_stall_ms": round(med_sync, 3),
+        "stall_ms_all": [round(v, 3) for v in stall_ms],
+        "sync_stall_ms_all": [round(v, 3) for v in sync_ms],
+        "persist_ms": [round(v, 3) for v in persist_ms],
+        "speedup": round(med_sync / med, 3) if med > 0 else None,
+        "last_generation_resumable": resumable,
+        "ok": med < med_sync and resumable == n_saves - 1,
+    }
+
+
+# ---- chaos leg --------------------------------------------------------------
+
+def run_chaos(steps: int, kill_step: int, work_root: str,
+              timeout: float) -> dict:
+    from chainermn_tpu.elastic.supervisor import Supervisor, SupervisorConfig
+    from chainermn_tpu.utils.proc_world import spawn_world
+
+    base_ckpt = os.path.join(work_root, "ckpt_baseline")
+    chaos_ckpt = os.path.join(work_root, "ckpt_chaos")
+    dump_dir = os.path.join(work_root, "dumps")
+    out_dir = os.path.join(work_root, "manifests")
+    for d in (base_ckpt, chaos_ckpt, dump_dir, out_dir):
+        os.makedirs(d, exist_ok=True)
+
+    # uninterrupted baseline: same worker, kill disabled
+    os.environ.update({"ELASTIC_SMOKE_STEPS": str(steps),
+                       "ELASTIC_SMOKE_CKPT": base_ckpt,
+                       "ELASTIC_SMOKE_KILL_STEP": "-1"})
+    try:
+        baseline = spawn_world(_WORKER, n_procs=2, local_devices=4,
+                               timeout=timeout)
+    finally:
+        for k in ("ELASTIC_SMOKE_STEPS", "ELASTIC_SMOKE_CKPT",
+                  "ELASTIC_SMOKE_KILL_STEP"):
+            os.environ.pop(k, None)
+    base_losses = {int(k): v for k, v in baseline[0]["losses"].items()}
+
+    cfg = SupervisorConfig(
+        n_procs=2, local_devices=4, max_restarts=2,
+        attempt_timeout_s=timeout, dump_dir=dump_dir, out_dir=out_dir,
+        ckpt_path=chaos_ckpt, ckpt_name="chaos",
+        env={
+            "ELASTIC_SMOKE_STEPS": str(steps),
+            "ELASTIC_SMOKE_CKPT": chaos_ckpt,
+            "ELASTIC_SMOKE_KILL_STEP": str(kill_step),
+            "ELASTIC_SMOKE_KILL_RANK": "1",
+            # fast heartbeat so the SURVIVOR's watchdog notices the
+            # killed peer and dumps inside the supervisor's grace window
+            "CHAINERMN_TPU_WATCHDOG_HEARTBEAT": "0.2",
+            "CHAINERMN_TPU_WATCHDOG_HB_TIMEOUT": "1.5",
+        })
+    sup = Supervisor(_WORKER, cfg)
+    try:
+        outcome = sup.run()
+    except RuntimeError as e:
+        # restart budget exhausted — emit a failing, inspectable
+        # artifact (manifests are on disk) instead of crashing the smoke
+        return {
+            "steps": steps, "kill_step": kill_step, "killed_rank": 1,
+            "supervisor_error": str(e),
+            "manifest": sup.manifests[0] if sup.manifests else None,
+            "restarts": max(len(sup.attempts) - 1, 0),
+            "checks": [{"name": "supervisor_recovered", "ok": False,
+                        "error": str(e)}],
+            "ok": False,
+        }
+
+    results = outcome["results"]
+    resumed = results[0]["resumed_from"]
+    lost = (kill_step - resumed) if resumed is not None else steps
+    chaos_losses = {int(k): v for k, v in results[0]["losses"].items()}
+    overlap = sorted(set(base_losses) & set(chaos_losses))
+    max_delta = max((abs(base_losses[t] - chaos_losses[t])
+                     for t in overlap), default=float("inf"))
+
+    manifest_path = outcome["manifests"][0] if outcome["manifests"] else None
+    manifest = None
+    n_dumps = 0
+    attribution_ok = False
+    if manifest_path:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        n_dumps = len(manifest.get("flight_dumps", []))
+        attribution_ok = isinstance(manifest.get("attribution"), dict) \
+            and "error" not in manifest["attribution"]
+
+    checks = [
+        {"name": "supervisor_restarted_once",
+         "ok": len(outcome["attempts"]) == 2,
+         "attempts": len(outcome["attempts"])},
+        {"name": "lost_steps_within_bound",
+         "ok": lost <= MAX_LOST_STEPS, "lost_steps": lost,
+         "bound": MAX_LOST_STEPS},
+        {"name": "resumed_losses_match_uninterrupted",
+         "ok": bool(overlap) and max_delta <= LOSS_TOLERANCE,
+         "steps_compared": len(overlap), "max_delta": max_delta,
+         "tolerance": LOSS_TOLERANCE},
+        {"name": "manifest_embeds_flight_dump",
+         "ok": manifest is not None and n_dumps >= 1,
+         "n_dumps": n_dumps},
+        {"name": "manifest_carries_attribution",
+         "ok": attribution_ok},
+    ]
+    return {
+        "steps": steps, "kill_step": kill_step, "killed_rank": 1,
+        "resumed_from": resumed, "lost_steps": lost,
+        "restarts": len(outcome["attempts"]) - 1,
+        "steps_compared": len(overlap),
+        "max_loss_delta": max_delta, "loss_tolerance": LOSS_TOLERANCE,
+        "manifest": manifest_path,
+        "manifest_reason": (manifest or {}).get("reason"),
+        "n_embedded_dumps": n_dumps,
+        "evidence": (manifest or {}).get("evidence"),
+        "checks": checks,
+        "ok": all(c["ok"] for c in checks),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=12,
+                    help="total train steps (default 12)")
+    ap.add_argument("--kill-step", type=int, default=7,
+                    help="step at which rank 1 SIGKILLs itself (default 7)")
+    ap.add_argument("--out", default="ELASTIC.json", metavar="PATH",
+                    help="artifact path (elastic_smoke/v1 JSON)")
+    ap.add_argument("--work-dir", default=None, metavar="DIR",
+                    help="checkpoints/dumps/manifests root "
+                         "(default: a temp dir, removed on success)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--skip-chaos", action="store_true",
+                    help="run only the in-process async A/B leg")
+    args = ap.parse_args(argv)
+
+    ensure_cpu_mesh(8)
+
+    async_ab = run_async_ab()
+    print(f"async_ckpt: stall {async_ab['stall_ms']:.2f} ms vs sync "
+          f"{async_ab['sync_stall_ms']:.2f} ms "
+          f"(x{async_ab['speedup']})", file=sys.stderr)
+
+    keep_work = args.work_dir is not None
+    work_root = args.work_dir or tempfile.mkdtemp(prefix="elastic_smoke_")
+    os.makedirs(work_root, exist_ok=True)
+    chaos = None
+    if not args.skip_chaos:
+        chaos = run_chaos(args.steps, args.kill_step, work_root,
+                          args.timeout)
+        for c in chaos["checks"]:
+            print(f"chaos {'ok' if c['ok'] else 'FAIL':>6} {c['name']}",
+                  file=sys.stderr)
+
+    ok = async_ab["ok"] and (chaos is None or chaos["ok"])
+    doc = {
+        "kind": "elastic_smoke/v1",
+        "ok": ok,
+        "async_ckpt": async_ab,
+        "chaos": chaos,
+    }
+    from chainermn_tpu.observability.ledger import stamp_envelope
+    stamp_envelope(doc, "elastic_smoke/v1", n_devices=8)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"ok": ok,
+                      "async_stall_ms": async_ab["stall_ms"],
+                      "lost_steps": chaos.get("lost_steps")
+                      if chaos else None}),
+          flush=True)
+    if ok and not keep_work:
+        shutil.rmtree(work_root, ignore_errors=True)
+    elif not ok:
+        print(f"elastic_smoke: FAIL — evidence under {work_root}",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -356,6 +356,24 @@ run 0 "$OUT/LEDGER_$ROUND.json" \
         && $PY_TPU tools/perf_gate.py --ledger '$OUT/LEDGER_$ROUND.json' \
             --out '$OUT/LEDGER_GATE_$ROUND.json'"
 
+# ---- elasticity: async checkpoint A/B + supervised chaos restart ------
+# Hardware-free (2-controller CPU-mesh world): the async backend's
+# on-step stall vs the sync npz save it replaces, then the ISSUE-19
+# chaos drill — SIGKILL one controller mid-run, the supervisor harvests
+# the survivor's flight dump into a restart_manifest/v1 and relaunches
+# from the newest consistent generation with at most ONE step of work
+# redone and loss parity against the uninterrupted run.  perf_gate
+# --elastic holds async_ckpt.stall_ms and chaos.lost_steps to the
+# async_ckpt_stall_ms / elastic_resume_lost_steps budgets
+# (docs/elasticity.md).
+run 0 "$OUT/ELASTIC_$ROUND.json" \
+    "elastic leg: async-checkpoint stall A/B + SIGKILL chaos restart under the elastic supervisor (<=1 step lost, manifest embeds flight dump + attribution), gated by perf_gate --elastic" -- \
+    bash -c "env JAX_PLATFORMS=cpu \
+        $PY_TPU tools/elastic_smoke.py --out '$OUT/ELASTIC_$ROUND.json' \
+            > /dev/null \
+        && $PY_TPU tools/perf_gate.py --elastic '$OUT/ELASTIC_$ROUND.json' \
+            --out '$OUT/ELASTIC_GATE_$ROUND.json'"
+
 # ---- THE two hardware-blocked numbers (north-star metric #2) ----------
 
 run 8 "$OUT/ALLREDUCE_SCALING_$ROUND.json" \

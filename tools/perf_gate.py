@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """perf_gate — fail loudly when a tracked benchmark regresses.
 
-Six modes, all exit nonzero on a gate failure so the runbook/CI leg
+Seven modes, all exit nonzero on a gate failure so the runbook/CI leg
 that invokes them goes red instead of silently recording a slower repo:
 
 1. Budget check (default)::
@@ -88,10 +88,26 @@ that invokes them goes red instead of silently recording a slower repo:
    against a CPU-host floor, and vice versa.  Writes a
    ``ledger_gate/v1`` artifact.
 
+7. Elastic gate::
+
+       python tools/perf_gate.py --elastic ELASTIC.json
+
+   Consumes a ``tools/elastic_smoke.py`` artifact (schema
+   ``elastic_smoke/v1``) and holds it to the elasticity floors from the
+   budgets file: ``async_ckpt.stall_ms`` at or below the
+   ``async_ckpt_stall_ms`` budget AND strictly below the measured sync
+   stall (the async backend must pay for itself), ``chaos.lost_steps``
+   at or below the ``elastic_resume_lost_steps`` budget (the "<1 step
+   of work lost" acceptance bound), both legs' ``ok`` true, and at
+   least one flight dump embedded in the restart manifest.  Writes an
+   ``elastic_smoke/v1+gate`` report next to the artifact.
+
 Wired into ``tools/multichip_day1.sh`` as the PERF_GATE, PLANNER,
-ONLINE_TUNE, SERVING_FLEET, PLANNER_GATE_ALLTOALL and LEDGER legs; see
-docs/collective_planner.md, docs/moe.md, docs/serving.md and
-docs/observability.md (Run ledger & regression diffing).
+ONLINE_TUNE, SERVING_FLEET, PLANNER_GATE_ALLTOALL, LEDGER and ELASTIC
+legs; see
+docs/collective_planner.md, docs/moe.md, docs/serving.md,
+docs/observability.md (Run ledger & regression diffing) and
+docs/elasticity.md.
 """
 
 import argparse
@@ -110,6 +126,7 @@ MOE_GATE_SCHEMA = "moe_gate/v1"
 MOE_BENCH_SCHEMA = "moe_bench/v1"
 LEDGER_GATE_SCHEMA = "ledger_gate/v1"
 JOINT_SWEEP_SCHEMA = "joint_sweep/v1"
+ELASTIC_SCHEMA = "elastic_smoke/v1"
 FLAT_ALLTOALL = "alltoall_flat"
 
 
@@ -624,6 +641,93 @@ def moe_gate(args):
     return 0 if ok else 1
 
 
+def elastic_gate(args):
+    """Gate a ``tools/elastic_smoke.py`` artifact against the elastic
+    floors in the budgets file: every chaos/async check must have
+    passed, the on-step async checkpoint stall must sit at or under the
+    ``async_ckpt_stall_ms`` budget (and measurably under the sync save
+    it replaces), and the supervised restart must have lost at most
+    ``elastic_resume_lost_steps`` steps of work."""
+    with open(args.elastic) as f:
+        doc = json.load(f)
+    if doc.get("schema") != ELASTIC_SCHEMA:
+        print(f"perf_gate: unsupported elastic schema "
+              f"{doc.get('schema')!r} (want {ELASTIC_SCHEMA!r})",
+              file=sys.stderr)
+        return 2
+    floors_path = args.floors or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf_budgets.json")
+    with open(floors_path) as f:
+        budgets = json.load(f)
+    floor = {m["name"]: float(m["budget"])
+             for m in budgets.get("metrics", [])}
+    problems = []
+    checks = []
+
+    def _ceiling(name, key, bound):
+        try:
+            value = _dig(doc, key)
+        except KeyError as e:
+            problems.append(f"{key} missing from artifact ({e}) — rerun "
+                            f"tools/elastic_smoke.py")
+            checks.append({"name": name, "key": key, "ceiling": bound,
+                           "value": None, "ok": False})
+            return None
+        ok = value <= bound
+        if not ok:
+            problems.append(f"{key} = {value:g}, ceiling is {bound:g}")
+        checks.append({"name": name, "key": key, "ceiling": bound,
+                       "value": value, "ok": ok})
+        print(f"perf_gate {'ok' if ok else 'FAIL':>9} {name}: "
+              f"value={value:g} ceiling={bound:g}", file=sys.stderr)
+        return value
+
+    stall = _ceiling("async_ckpt_stall_ms", "async_ckpt.stall_ms",
+                     floor.get("async_ckpt_stall_ms", 5.0))
+    sync_stall = (doc.get("async_ckpt") or {}).get("sync_stall_ms")
+    if stall is not None and sync_stall is not None \
+            and stall >= float(sync_stall):
+        problems.append(f"async stall {stall:g} ms does not beat the "
+                        f"sync save it replaces ({sync_stall:g} ms) — "
+                        f"the background persist is not paying")
+    _ceiling("elastic_resume_lost_steps", "chaos.lost_steps",
+             floor.get("elastic_resume_lost_steps", 1.0))
+    for section in ("async_ckpt", "chaos"):
+        sec = doc.get(section)
+        if sec is None:
+            problems.append(f"artifact has no {section} section — rerun "
+                            f"tools/elastic_smoke.py without --skip-chaos")
+        elif not sec.get("ok"):
+            failed = [c["name"] for c in sec.get("checks", [])
+                      if not c.get("ok")]
+            problems.append(f"{section} leg failed its own checks"
+                            + (f": {failed}" if failed else ""))
+    chaos = doc.get("chaos") or {}
+    if chaos and not chaos.get("n_embedded_dumps"):
+        problems.append("restart manifest embeds no flight dump — the "
+                        "incident evidence chain is broken")
+    ok = not problems
+    report = {"schema": ELASTIC_SCHEMA + "+gate",
+              "artifact": os.path.basename(args.elastic),
+              "floors": floors_path,
+              "checks": checks,
+              "restarts": chaos.get("restarts"),
+              "lost_steps": chaos.get("lost_steps"),
+              "async_speedup": (doc.get("async_ckpt") or {}).get("speedup"),
+              "problems": problems,
+              "ok": ok}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"ok": ok, "checked": len(checks),
+                      "lost_steps": chaos.get("lost_steps")}), flush=True)
+    if not ok:
+        for p in problems:
+            print(f"perf_gate: FAIL — {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def ledger_gate(args):
     """Budget check with per-(device_kind, schema) baselines from the
     run ledger.  For each tracked metric the newest matching artifact
@@ -817,6 +921,13 @@ def main():
     parser.add_argument("--joint-threshold", type=float, default=1.05,
                         help="joint mode: minimum modeled "
                              "comparison.speedup to pass (default 1.05)")
+    parser.add_argument("--elastic", default=None, metavar="ELASTIC.json",
+                        help="elastic gate mode: tools/elastic_smoke.py "
+                             f"artifact (schema {ELASTIC_SCHEMA}) held to "
+                             "the async_ckpt_stall_ms and "
+                             "elastic_resume_lost_steps floors, with every "
+                             "chaos check green and the restart manifest "
+                             "carrying embedded flight-dump evidence")
     parser.add_argument("--ledger", default=None, metavar="LEDGER.json",
                         help="ledger-gate mode: run-ledger JSONL or "
                              "run_ledger/v1 snapshot; budget metrics are "
@@ -828,11 +939,13 @@ def main():
     args = parser.parse_args()
     modes = [bool(args.budgets), bool(args.planner),
              bool(args.online_tune), bool(args.serving), bool(args.moe),
-             bool(args.joint), bool(args.ledger)]
+             bool(args.joint), bool(args.ledger), bool(args.elastic)]
     if sum(modes) != 1:
         parser.error("pass exactly one of --budgets, --planner, "
-                     "--online-tune, --serving, --moe, --joint, or "
-                     "--ledger")
+                     "--online-tune, --serving, --moe, --joint, "
+                     "--ledger, or --elastic")
+    if args.elastic:
+        return elastic_gate(args)
     if args.planner:
         return planner_gate(args)
     if args.online_tune:
