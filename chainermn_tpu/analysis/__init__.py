@@ -9,6 +9,13 @@ and compiled HLO, a rule registry (``schedule-desync``,
 ``donation-alias``, ``wire-dtype-mismatch``, ``async-pair``), the
 :func:`lint_step` one-liner, and the named entry points behind
 ``tools/cmn_lint.py``.  Rule catalog: ``docs/static_analysis.md``.
+
+The *control-plane* half lives in ``analysis/protocol.py``: an AST
+protocol model of every host object-plane call site (tags, roots, rank
+guards, exception paths) feeding the ``tag-band-collision``,
+``lockstep-divergence``, ``unmatched-send-recv``,
+``wrapper-surface-drift``, and ``protocol-replay-desync`` rules —
+``cmn_lint --protocol``.
 """
 
 from chainermn_tpu.analysis.captured import (
@@ -31,6 +38,13 @@ from chainermn_tpu.analysis.lint import (
     build_grad_probe,
     lint_step,
 )
+from chainermn_tpu.analysis.protocol import (
+    CallSite,
+    ProtocolModel,
+    extract_protocol,
+    load_events_by_rank,
+    replay_flight,
+)
 from chainermn_tpu.analysis.rules import (
     Finding,
     all_rules,
@@ -47,12 +61,13 @@ from chainermn_tpu.analysis.schedule import (
 )
 
 __all__ = [
-    "COLLECTIVE_PRIMITIVES", "CapturedConstantError",
+    "COLLECTIVE_PRIMITIVES", "CallSite", "CapturedConstantError",
     "CollectiveOp", "CollectiveSchedule", "DEFAULT_MAX_BYTES",
     "Finding", "HloCollective", "HloParse",
-    "LintContext", "LintError", "LintReport", "all_rules",
+    "LintContext", "LintError", "LintReport", "ProtocolModel", "all_rules",
     "allreduce_hlo", "assert_no_captured_constants", "build_grad_probe",
-    "collective_census", "expected_kinds", "extract_schedule",
-    "find_captured_constants", "get_rule", "lint_step",
-    "parse_hlo_collectives", "rule", "schedule_from_hlo",
+    "collective_census", "expected_kinds", "extract_protocol",
+    "extract_schedule", "find_captured_constants", "get_rule",
+    "lint_step", "load_events_by_rank", "parse_hlo_collectives",
+    "replay_flight", "rule", "schedule_from_hlo",
 ]

@@ -102,7 +102,7 @@ class LintContext:
                  donate_argnums=(), fsdp_meta=None, fsdp_state=None,
                  variants=None, census=False, hlo=True,
                  max_const_bytes=DEFAULT_MAX_BYTES, flight_events=None,
-                 artifact_root=None):
+                 artifact_root=None, protocol_root=None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs or {}
@@ -125,6 +125,7 @@ class LintContext:
         self._variants_spec = variants
         self.flight_events = flight_events
         self.artifact_root = artifact_root
+        self.protocol_root = protocol_root
         self.census = census
         self.hlo = hlo
         self.max_const_bytes = max_const_bytes
@@ -290,6 +291,30 @@ class LintContext:
         return self._memo("flight_spans", build)
 
     @property
+    def protocol_model(self):
+        """Static control-plane protocol model (``analysis/protocol.py``)
+        — the input of the tag-band-collision / lockstep-divergence /
+        unmatched-send-recv / wrapper-surface-drift / replay rules.
+        ``protocol_root=True`` walks the installed ``chainermn_tpu``
+        package; a path walks that tree (the fixture tests' path); an
+        already-built :class:`~chainermn_tpu.analysis.protocol.
+        ProtocolModel` (or its ``to_json()`` dict) is used as-is."""
+        def build():
+            root = self.protocol_root
+            if not root:
+                self.unavailable["protocol_model"] = \
+                    "no protocol_root given (pass protocol_root=)"
+                return None
+            from chainermn_tpu.analysis.protocol import (
+                ProtocolModel, extract_protocol)
+            if isinstance(root, ProtocolModel):
+                return root
+            if isinstance(root, dict):
+                return ProtocolModel.from_json(root)
+            return extract_protocol(None if root is True else root)
+        return self._memo("protocol_model", build)
+
+    @property
     def artifact_census(self) -> Optional[List[dict]]:
         """Every committed artifact under ``artifact_root``, parsed and
         classified against the run-ledger schema registry — the
@@ -398,7 +423,7 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
               fsdp_meta=None, fsdp_state=None, variants=None,
               census=False, hlo: bool = True,
               max_const_bytes: int = DEFAULT_MAX_BYTES,
-              flight_events=None, artifact_root=None,
+              flight_events=None, artifact_root=None, protocol_root=None,
               rules: Optional[Sequence[str]] = None,
               raise_on_error: bool = True, name: str = "",
               **kwargs) -> LintReport:
@@ -418,7 +443,8 @@ def lint_step(fn, *args, comm=None, flavor=None, inter_size=None,
                       census=census, hlo=hlo,
                       max_const_bytes=max_const_bytes,
                       flight_events=flight_events,
-                      artifact_root=artifact_root)
+                      artifact_root=artifact_root,
+                      protocol_root=protocol_root)
     report = LintReport(target=ctx.name)
     selected = [get_rule(r) for r in rules] if rules else all_rules()
     for rule in selected:

@@ -830,6 +830,280 @@ def _artifact_drift(ctx) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# control-plane protocol rules — read the static ProtocolModel
+# (analysis/protocol.py); ctx.protocol_model is built from protocol_root
+# ---------------------------------------------------------------------------
+
+def _overlaps(a, b) -> bool:
+    return a[0] < b[1] and b[0] < a[1]
+
+
+def _const_sites(model):
+    """Sites with a resolved constant tag, excluding op-default tags (the
+    sanctioned defaults: tag=0 plane, barrier 900)."""
+    return [s for s in model.sites
+            if s.tag.get("kind") == "const"
+            and s.tag.get("provenance") != "default"
+            and s.tag.get("value") is not None]
+
+
+@rule("tag-band-collision", "error",
+      "control-plane tag sets of two subsystems must not intersect",
+      requires=("protocol_model",))
+def _tag_band_collision(ctx) -> List[Finding]:
+    """Tags are the only thing keeping concurrent object-plane protocols
+    apart on a shared DCN edge (TELEMETRY_TAG=770, barrier 900,
+    FLIGHT_TAG=(1<<28)+7, the default tag-0 plane) — and until now they
+    were kept apart by comments.  Two failure shapes: a magic number
+    landing inside a reserved band it does not own, and two subsystems'
+    resolved tag intervals intersecting (arithmetic neighbors included:
+    an allgather at t also consumes t+1).  A collision means a recv can
+    complete against the WRONG protocol's payload — the worst kind of
+    desync, because nothing hangs until the unpickle explodes."""
+    from chainermn_tpu.runtime.control_plane import RESERVED_TAG_BANDS
+    model = ctx.protocol_model
+    out: List[Finding] = []
+    bands = [b for b in RESERVED_TAG_BANDS.values() if b.name != "default"]
+    default = RESERVED_TAG_BANDS["default"]
+    sites = [s for s in _const_sites(model)
+             # intervals fully inside the default band ride the shared
+             # tag-0 plane — sanctioned for everyone
+             if not (s.tag_interval()[0] >= default.base
+                     and s.tag_interval()[1] <= default.stop)]
+    # (a) magic literals inside a reserved band
+    for s in sites:
+        if s.tag.get("provenance") != "literal":
+            continue
+        iv = s.tag_interval()
+        for band in bands:
+            if _overlaps(iv, (band.base, band.stop)):
+                out.append(_finding(
+                    f"{s.where()}: literal tag {s.tag['source']} lands in "
+                    f"the reserved {band.name!r} band "
+                    f"[{band.base}, {band.stop}) owned by {band.owner} — "
+                    f"import the named tag from "
+                    f"runtime.control_plane.RESERVED_TAG_BANDS instead "
+                    f"of a magic number",
+                    site=s.as_dict(), band=band.as_dict()))
+    # (b) cross-subsystem interval intersections
+    seen = set()
+    for i, a in enumerate(sites):
+        for b in sites[i + 1:]:
+            if a.subsystem == b.subsystem:
+                continue
+            iva, ivb = a.tag_interval(), b.tag_interval()
+            if not _overlaps(iva, ivb):
+                continue
+            # a matched p2p channel across subsystems is deliberate
+            if {a.op, b.op} == {"send_obj", "recv_obj"} \
+                    or (a.raw and b.raw and {a.op, b.op} == {"send",
+                                                            "recv"}):
+                continue
+            # both sides naming the same reserved band is the sanctioned
+            # way to share it (gather_telemetry's producer + consumer)
+            band = next((bd for bd in bands
+                         if iva[0] >= bd.base and iva[1] <= bd.stop
+                         and ivb[0] >= bd.base and ivb[1] <= bd.stop), None)
+            if band is not None and a.tag.get("provenance") == "named" \
+                    and b.tag.get("provenance") == "named":
+                continue
+            key = (a.file, a.line, b.file, b.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(_finding(
+                f"{a.where()} ({a.subsystem}: {a.op} on tags "
+                f"[{iva[0]}, {iva[1]})) collides with {b.where()} "
+                f"({b.subsystem}: {b.op} on tags [{ivb[0]}, {ivb[1]})) — "
+                f"two subsystems share a wire tag, so either protocol "
+                f"can consume the other's payload; claim a band in "
+                f"RESERVED_TAG_BANDS",
+                site=a.as_dict(), other=b.as_dict()))
+    return out
+
+
+@rule("lockstep-divergence", "error",
+      "collective object ops must be reachable on every rank's path",
+      requires=("protocol_model",))
+def _lockstep_divergence(ctx) -> List[Finding]:
+    """The static twin of the flight recorder's ``identify_desync``: a
+    collective object op under a rank guard (``if rank == 0:``) with no
+    collective on the complementary branch means the guarded ranks enter
+    a tree collective their peers never join — the exact hang the
+    watchdog diagnoses post-mortem, caught before a mesh is involved.
+    Same logic for except-handlers: a collective that only runs on the
+    exception path desyncs the ranks that did not fault."""
+    model = ctx.protocol_model
+    out: List[Finding] = []
+    collectives = model.collectives()
+    for s in collectives:
+        if s.rank_guards:
+            g = s.rank_guards[-1]
+            complement = "orelse" if g["branch"] == "body" else "body"
+            matched = any(
+                o is not s and o.file == s.file and any(
+                    og.get("line") == g["line"]
+                    and og.get("branch") == complement
+                    for og in o.guards)
+                for o in collectives)
+            if not matched:
+                out.append(_finding(
+                    f"{s.where()}: collective {s.op} runs only under rank "
+                    f"guard `{g['test']}` ({g['branch']} branch) with no "
+                    f"collective on the complementary path — unguarded "
+                    f"ranks never join the tree and the mesh wedges "
+                    f"(identify_desync would report this rank stuck in "
+                    f"{s.op})",
+                    site=s.as_dict(), guard=g))
+        for t in s.trys:
+            if t["branch"] != "except":
+                continue
+            matched = any(
+                o is not s and o.collective and o.file == s.file and any(
+                    ot.get("line") == t["line"]
+                    and ot.get("branch") == "try"
+                    for ot in o.trys)
+                for o in model.sites)
+            if not matched:
+                out.append(_finding(
+                    f"{s.where()}: collective {s.op} runs only on an "
+                    f"except path (try at line {t['line']}) — ranks that "
+                    f"did not fault sail past while the faulted rank "
+                    f"blocks in {s.op}",
+                    site=s.as_dict(), try_line=t["line"]))
+    return out
+
+
+def _p2p_key_matches(a, b) -> bool:
+    """Can send site ``a`` pair with recv site ``b``? Same plane (raw vs
+    object), and overlapping tag sets: const↔const by interval, param↔
+    param by base offset; a dynamic tag is a wildcard (statically
+    unknowable — never report it unmatched, never let it mask a const
+    mismatch elsewhere)."""
+    if a.raw != b.raw:
+        return False
+    ta, tb = a.tag, b.tag
+    if "dynamic" in (ta.get("kind"), tb.get("kind")):
+        return True
+    if ta.get("kind") == "const" and tb.get("kind") == "const":
+        return _overlaps(a.tag_interval(), b.tag_interval())
+    if ta.get("kind") == "param" and tb.get("kind") == "param":
+        return ta.get("base") == tb.get("base")
+    # const vs param: a parametric endpoint can be instantiated at the
+    # const tag iff the const lies in the param namespace's band
+    cs, ps = (ta, tb) if ta.get("kind") == "const" else (tb, ta)
+    return cs.get("value", -1) >= ps.get("base", 0)
+
+
+@rule("unmatched-send-recv", "error",
+      "every p2p send needs a structurally matching recv (and vice versa)",
+      requires=("protocol_model",))
+def _unmatched_send_recv(ctx) -> List[Finding]:
+    """A ``send_obj`` whose (plane, tag) no ``recv_obj`` in the tree can
+    match blocks forever once the transport's buffering runs out — and an
+    orphaned recv blocks immediately.  This is the seam ROADMAP item 2's
+    pipeline-parallel p2p stages will stress: every new stage boundary
+    adds a send/recv pair that must line up by tag."""
+    model = ctx.protocol_model
+    out: List[Finding] = []
+    sends = [s for s in model.p2p()
+             if s.op in ("send_obj", "send")]
+    recvs = [s for s in model.p2p()
+             if s.op in ("recv_obj", "recv")]
+    for s in sends:
+        if not any(_p2p_key_matches(s, r) for r in recvs):
+            out.append(_finding(
+                f"{s.where()}: {s.op} on tag {s.tag.get('source')} has no "
+                f"structurally matching recv anywhere in the tree — the "
+                f"payload is never consumed and the peer's inbox grows "
+                f"until the transport stalls",
+                site=s.as_dict()))
+    for r in recvs:
+        if not any(_p2p_key_matches(s, r) for s in sends):
+            out.append(_finding(
+                f"{r.where()}: {r.op} on tag {r.tag.get('source')} has no "
+                f"structurally matching send anywhere in the tree — this "
+                f"endpoint blocks forever",
+                site=r.as_dict()))
+    return out
+
+
+@rule("wrapper-surface-drift", "error",
+      "wrapper classes must accept and forward the full wrapped surface",
+      requires=("protocol_model",))
+def _wrapper_surface_drift(ctx) -> List[Finding]:
+    """A proxy that forwards an object op while silently narrowing its
+    signature turns a working call into a TypeError — exactly the
+    ``InstrumentedCommunicator`` bug where ``gather_obj`` dropped
+    ``tag=`` and every instrumented ``gather_telemetry``
+    (tag=TELEMETRY_TAG) exploded.  Generic check: a class forwarding two
+    or more object ops to the same wrapped attribute must, for each
+    forwarded op, accept every optional parameter some implementation of
+    that op defines, and actually pass it across the forwarding
+    boundary."""
+    model = ctx.protocol_model
+    out: List[Finding] = []
+    reference: Dict[str, set] = {}
+    for c in model.class_ops:
+        if not c.forwards_to:
+            reference.setdefault(c.op, set()).update(c.optional_params)
+    by_wrapper: Dict[tuple, list] = {}
+    for c in model.class_ops:
+        if c.forwards_to:
+            by_wrapper.setdefault((c.file, c.cls, c.forwards_to),
+                                  []).append(c)
+    for (file, cls, attr), ops in by_wrapper.items():
+        if len(ops) < 2:   # a one-off delegation is not a wrapper surface
+            continue
+        for c in ops:
+            ref = reference.get(c.op, set())
+            dropped = sorted(ref - set(c.params))
+            if dropped:
+                out.append(_finding(
+                    f"{c.file}:{c.line}: {cls}.{c.op} forwards to "
+                    f"self.{attr} but does not accept "
+                    f"{', '.join(dropped)} — parameters the wrapped "
+                    f"surface takes; callers passing them get a "
+                    f"TypeError only through the wrapper",
+                    cls=cls, op=c.op, file=c.file, line=c.line,
+                    dropped=dropped, forwards_to=attr))
+            swallowed = sorted((ref & set(c.params))
+                               - set(c.forwarded_params))
+            if swallowed:
+                out.append(_finding(
+                    f"{c.file}:{c.line}: {cls}.{c.op} accepts "
+                    f"{', '.join(swallowed)} but drops them at the "
+                    f"forwarding boundary to self.{attr} — the wrapped "
+                    f"call silently runs with defaults",
+                    cls=cls, op=c.op, file=c.file, line=c.line,
+                    swallowed=swallowed, forwards_to=attr))
+    return out
+
+
+@rule("protocol-replay-desync", "error",
+      "recorded object-plane event sequences must agree across ranks",
+      requires=("protocol_model", "flight_events"))
+def _protocol_replay_desync(ctx) -> List[Finding]:
+    """Replay a flight dump's per-rank object-plane events against the
+    static model: ranks that completed different op sequences, or a rank
+    wedged inside an op its peers sailed past, are protocol violations —
+    with the model's rank-guarded collective sites attached as prime
+    suspects.  This is the triage path for ``elastic_run`` incident
+    manifests (restart_manifest/v1 embeds the per-rank dumps)."""
+    from chainermn_tpu.analysis.protocol import (
+        load_events_by_rank, replay_flight)
+    events = load_events_by_rank(ctx.flight_events)
+    out: List[Finding] = []
+    for v in replay_flight(ctx.protocol_model, events):
+        f = _finding(v["message"], **{k: val for k, val in v.items()
+                                      if k != "message"})
+        if v.get("kind") == "unknown-op":
+            f.severity = "info"
+        out.append(f)
+    return out
+
+
 __all__ = ["CPU_WIRE_PROMOTIONS", "DRIFT_TOLERANCE_X", "Finding",
            "NP_TO_HLO_DTYPE", "Rule", "SEVERITIES", "all_rules",
            "expected_kinds", "get_rule", "rule"]

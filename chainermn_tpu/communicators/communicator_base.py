@@ -74,27 +74,35 @@ class CommunicatorBase(abc.ABC):
     @abc.abstractmethod
     def recv_obj(self, source: int, tag: int = 0) -> Any: ...
 
+    # Every collective object op carries ``tag=`` end to end — reserved
+    # bands (telemetry, barrier, ...) ride these entry points, so a
+    # communicator that narrowed the signature would strand them (see
+    # runtime.control_plane.RESERVED_TAG_BANDS and the
+    # wrapper-surface-drift protocol lint rule).
     @abc.abstractmethod
-    def bcast_obj(self, obj: Any, root: int = 0) -> Any: ...
+    def bcast_obj(self, obj: Any, root: int = 0, tag: int = 0) -> Any: ...
 
     @abc.abstractmethod
-    def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]: ...
+    def gather_obj(self, obj: Any, root: int = 0,
+                   tag: int = 0) -> Optional[List[Any]]: ...
 
     @abc.abstractmethod
-    def allgather_obj(self, obj: Any) -> List[Any]: ...
+    def allgather_obj(self, obj: Any, tag: int = 0) -> List[Any]: ...
 
     @abc.abstractmethod
-    def scatter_obj(self, objs: Optional[List[Any]], root: int = 0) -> Any: ...
+    def scatter_obj(self, objs: Optional[List[Any]], root: int = 0,
+                    tag: int = 0) -> Any: ...
 
     @abc.abstractmethod
     def allreduce_obj(self, obj: Any,
-                      op: "str | Callable[[Any, Any], Any]" = "sum") -> Any:
+                      op: "str | Callable[[Any, Any], Any]" = "sum",
+                      tag: int = 0) -> Any:
         """Reduce picklable objects across hosts.  ``op``: "sum"/"prod"/
         "max"/"min" (applied structurally through dicts/lists, ndarray-aware)
         or any binary callable for custom reducibles."""
 
     @abc.abstractmethod
-    def barrier(self) -> None: ...
+    def barrier(self, tag: int = 900) -> None: ...
 
     # ---- device plane (traced SPMD collectives) ----------------------------
     @abc.abstractmethod

@@ -241,23 +241,23 @@ class MeshCommunicator(CommunicatorBase):
     def recv_obj(self, source, tag=0):
         return self._cp.recv_obj(source, tag=tag)
 
-    def bcast_obj(self, obj, root=0):
-        return self._cp.bcast_obj(obj, root=root)
+    def bcast_obj(self, obj, root=0, tag=0):
+        return self._cp.bcast_obj(obj, root=root, tag=tag)
 
-    def gather_obj(self, obj, root=0):
-        return self._cp.gather_obj(obj, root=root)
+    def gather_obj(self, obj, root=0, tag=0):
+        return self._cp.gather_obj(obj, root=root, tag=tag)
 
-    def allgather_obj(self, obj):
-        return self._cp.allgather_obj(obj)
+    def allgather_obj(self, obj, tag=0):
+        return self._cp.allgather_obj(obj, tag=tag)
 
-    def scatter_obj(self, objs, root=0):
-        return self._cp.scatter_obj(objs, root=root)
+    def scatter_obj(self, objs, root=0, tag=0):
+        return self._cp.scatter_obj(objs, root=root, tag=tag)
 
-    def allreduce_obj(self, obj, op="sum"):
-        return self._cp.allreduce_obj(obj, op=op)
+    def allreduce_obj(self, obj, op="sum", tag=0):
+        return self._cp.allreduce_obj(obj, op=op, tag=tag)
 
-    def barrier(self):
-        self._cp.barrier()
+    def barrier(self, tag=900):
+        self._cp.barrier(tag=tag)
 
     # ---- SPMD context ------------------------------------------------------
     def _axis_arg(self):

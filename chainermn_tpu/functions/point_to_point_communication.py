@@ -170,8 +170,12 @@ def spmd_send_recv(x, communicator, pairs: List[Tuple[int, int]]):
 # rather than calling the model separately from the grad.
 # ---------------------------------------------------------------------------
 
-_GRAD_TAG_OFFSET = 1 << 20   # reverse-transfer (cotangent) tag namespace
-_META_TAG_OFFSET = 1 << 21   # trace-time shape/treedef handshake namespace
+# Tag namespaces claimed as the "p2p_grad" / "p2p_meta" bands in
+# runtime.control_plane.RESERVED_TAG_BANDS.
+from chainermn_tpu.runtime.control_plane import reserved_tag as _reserved_tag
+
+_GRAD_TAG_OFFSET = _reserved_tag("p2p_grad")   # reverse-transfer (cotangent)
+_META_TAG_OFFSET = _reserved_tag("p2p_meta")   # shape/treedef handshake
 
 
 def _is_inexact(leaf) -> bool:

@@ -78,6 +78,7 @@ KNOWN_SCHEMAS = {
     "attribution_smoke/v1",
     "bench_headline/v1",
     "cmn_lint/v1",
+    "protocol_lint/v1",
     "db_overlap_check/v1",
     "restart_manifest/v1",
     "elastic_smoke/v1",

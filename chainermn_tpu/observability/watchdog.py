@@ -40,11 +40,14 @@ from dataclasses import dataclass, replace
 from typing import Dict, List, Optional
 
 from chainermn_tpu.observability import flight_recorder as _flight
+from chainermn_tpu.runtime.control_plane import reserved_tag
 
-# Dedicated control-plane tag namespace for watchdog traffic.  Far above
-# the collective tags (tag<~1000), the p2p grad tags (1<<20) and meta
-# tags (1<<21), so watchdog messages never collide with training traffic.
-FLIGHT_TAG = (1 << 28) + 7
+# Dedicated control-plane tag namespace for watchdog traffic, claimed as
+# the "flight" band in runtime.control_plane.RESERVED_TAG_BANDS.  Far
+# above the collective tags (tag<~1000), the p2p grad tags (1<<20) and
+# meta tags (1<<21), so watchdog messages never collide with training
+# traffic.
+FLIGHT_TAG = reserved_tag("flight")
 
 _THREAD_PREFIX = "chainermn-tpu-watchdog"
 

@@ -63,11 +63,91 @@ def _structural(op):
     return apply
 
 
+class TagBand:
+    """One reserved slice of the control-plane tag space.
+
+    ``base`` is the first tag in the band and ``width`` the number of
+    consecutive tags the owner may consume starting there.  Width matters
+    because the tree collectives are *arithmetic* consumers: a call to
+    :meth:`ControlPlane.allgather_obj` or :meth:`ControlPlane.allreduce_obj`
+    at ``tag`` uses both ``tag`` (the fold/gather leg) and ``tag + 1``
+    (the broadcast leg), so every band they ride needs width >= 2.
+    """
+
+    __slots__ = ("name", "base", "width", "owner", "doc")
+
+    def __init__(self, name: str, base: int, width: int, owner: str,
+                 doc: str = ""):
+        self.name = name
+        self.base = base
+        self.width = width
+        self.owner = owner
+        self.doc = doc
+
+    @property
+    def stop(self) -> int:
+        """One past the last tag in the band."""
+        return self.base + self.width
+
+    def __contains__(self, tag: int) -> bool:
+        return self.base <= tag < self.stop
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "base": self.base, "width": self.width,
+                "owner": self.owner, "doc": self.doc}
+
+    def __repr__(self):
+        return (f"TagBand({self.name!r}, base={self.base}, "
+                f"width={self.width}, owner={self.owner!r})")
+
+
+#: Central registry of every reserved control-plane tag band.  Subsystems
+#: that need a private tag namespace MUST claim a band here instead of
+#: picking a magic number — ``cmn_lint --protocol`` (tag-band-collision)
+#: cross-checks every static call site against this table.
+RESERVED_TAG_BANDS = {band.name: band for band in (
+    TagBand("default", 0, 2, "runtime",
+            "The tag=0 object plane every collective defaults to; "
+            "allgather/allreduce consume tags 0 and 1."),
+    TagBand("telemetry", 770, 2, "observability",
+            "Streaming fleet-telemetry gathers "
+            "(ControlPlane.gather_telemetry)."),
+    TagBand("barrier", 900, 2, "runtime",
+            "ControlPlane.barrier rides an allgather at 900, "
+            "so it consumes 900 and 901."),
+    TagBand("p2p_grad", 1 << 20, 1 << 20, "functions",
+            "Reverse-transfer (cotangent) namespace for cross-process "
+            "p2p: user tag t maps to (1<<20) + t."),
+    TagBand("p2p_meta", 1 << 21, 1 << 20, "functions",
+            "Trace-time shape/treedef handshake namespace for "
+            "cross-process p2p: user tag t maps to (1<<21) + t."),
+    TagBand("flight", (1 << 28) + 7, 1, "observability",
+            "Watchdog flight-dump solicitation over the raw transport."),
+)}
+
+
+def reserved_tag(name: str) -> int:
+    """Base tag of the named reserved band (KeyError on unknown names)."""
+    return RESERVED_TAG_BANDS[name].base
+
+
+def band_of(tag: int):
+    """The :class:`TagBand` covering ``tag``, or None if unreserved."""
+    for band in RESERVED_TAG_BANDS.values():
+        if tag in band:
+            return band
+    return None
+
+
 #: Reserved tag band for the streaming fleet-telemetry aggregator
 #: (observability/streaming.py).  Kept far from the default tag=0 object
 #: plane and the barrier band (900) so per-step telemetry gathers can
 #: never cross wires with user sends in flight on the same edge.
-TELEMETRY_TAG = 770
+TELEMETRY_TAG = reserved_tag("telemetry")
+
+#: Default barrier tag — barrier() is an allgather at this tag, so it
+#: consumes BARRIER_TAG and BARRIER_TAG + 1 (see the "barrier" band).
+BARRIER_TAG = reserved_tag("barrier")
 
 
 def _resolve_op(op):
@@ -148,6 +228,9 @@ class ControlPlane(abc.ABC):
         return [acc[(r - root) % self.size] for r in range(self.size)]
 
     def allgather_obj(self, obj: Any, tag: int = 0) -> List[Any]:
+        # Arithmetic tag consumer: the gather leg runs at ``tag`` and the
+        # broadcast leg at ``tag + 1`` — callers must own BOTH tags (see
+        # RESERVED_TAG_BANDS; every band an allgather rides needs width 2).
         gathered = self.gather_obj(obj, root=0, tag=tag)
         return self.bcast_obj(gathered, root=0, tag=tag + 1)
 
@@ -201,11 +284,13 @@ class ControlPlane(abc.ABC):
         in the last ulp across world sizes (deterministic for a fixed
         size/topology) — same caveat as MPI's tree allreduce.
         """
+        # Arithmetic tag consumer like allgather_obj: fold at ``tag``,
+        # broadcast at ``tag + 1``.
         fold = _resolve_op(op)
         acc = self._tree_fold(obj, 0, tag, fold=fold)
         return self.bcast_obj(acc, root=0, tag=tag + 1)
 
-    def barrier(self, tag: int = 900) -> None:
+    def barrier(self, tag: int = BARRIER_TAG) -> None:
         self.allgather_obj(None, tag=tag)
 
     def gather_telemetry(self, summary: Any, root: int = 0) -> Optional[List[Any]]:

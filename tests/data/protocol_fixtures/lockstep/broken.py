@@ -1,0 +1,21 @@
+"""Broken fixture: a collective object op reachable on only one side of a
+rank guard — the static shape of every lockstep hang the watchdog has
+ever diagnosed.  Non-root ranks never enter the bcast, so root blocks in
+the broadcast tree forever.
+"""
+
+
+def announce_plan(comm, plan):
+    if comm.rank == 0:
+        # BUG: only rank 0 participates in the collective.
+        return comm.bcast_obj(plan, root=0)
+    return None
+
+
+def flush_on_error(comm, payload):
+    try:
+        comm.send_obj(payload, 1, tag=3)
+    except RuntimeError:
+        # BUG: the barrier only runs on the exception path, so ranks that
+        # did not fault sail past while the faulted rank blocks.
+        comm.barrier()

@@ -1,0 +1,8 @@
+"""Broken fixture: subsystem A claims an explicit tag band by magic number."""
+
+HEALTH_TAG = 640
+
+
+def ship_health(plane, summary):
+    # allgather is an arithmetic consumer: uses HEALTH_TAG and HEALTH_TAG+1.
+    return plane.allgather_obj(summary, tag=HEALTH_TAG)

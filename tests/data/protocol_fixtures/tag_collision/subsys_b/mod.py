@@ -1,0 +1,15 @@
+"""Broken fixture: subsystem B lands on subsystem A's arithmetic neighbor.
+
+``ship_health`` in subsys_a allgathers at 640, which also consumes 641 —
+exactly the tag this module's send/recv pair picked.
+"""
+
+SYNC_TAG = 641
+
+
+def push(plane, obj, dest):
+    plane.send_obj(obj, dest, tag=SYNC_TAG)
+
+
+def pull(plane, source):
+    return plane.recv_obj(source, tag=SYNC_TAG)

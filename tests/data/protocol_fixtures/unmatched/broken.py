@@ -1,0 +1,13 @@
+"""Broken fixture: a p2p send with no structurally matching recv.
+
+``push_result`` ships on tag 7 but the only receiver in the tree listens
+on tag 9 — the send blocks (or the recv does) forever.
+"""
+
+
+def push_result(plane, obj, dest):
+    plane.send_obj(obj, dest, tag=7)
+
+
+def pull_result(plane, source):
+    return plane.recv_obj(source, tag=9)

@@ -1,0 +1,32 @@
+"""Full-signature object-plane base for the wrapper-drift fixture tree.
+
+Mirrors the ``ControlPlane`` / communicator object-plane surface so the
+wrapper-surface-drift rule has reference signatures to compare the frozen
+pre-fix ``InstrumentedCommunicator`` snapshot against.
+"""
+
+
+class BaseComm:
+    def send_obj(self, obj, dest, tag=0):
+        pass
+
+    def recv_obj(self, source, tag=0):
+        pass
+
+    def bcast_obj(self, obj, root=0, tag=0):
+        pass
+
+    def gather_obj(self, obj, root=0, tag=0):
+        pass
+
+    def allgather_obj(self, obj, tag=0):
+        pass
+
+    def scatter_obj(self, objs, root=0, tag=0):
+        pass
+
+    def allreduce_obj(self, obj, op="sum", tag=0):
+        pass
+
+    def barrier(self, tag=900):
+        pass

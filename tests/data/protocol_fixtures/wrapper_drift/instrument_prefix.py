@@ -1,4 +1,16 @@
-"""Instrumented communicators — the collective data path, measured.
+"""FROZEN pre-fix snapshot of chainermn_tpu/observability/instrument.py.
+
+This is the object-plane tag-drop bug as it shipped: the wrapper's
+bcast_obj/gather_obj/allgather_obj/scatter_obj/allreduce_obj/barrier
+forward to the wrapped communicator WITHOUT accepting or forwarding the
+``tag=`` keyword the base signatures take, so gather_telemetry
+(tag=TELEMETRY_TAG) TypeErrors through an instrumented comm.  Kept as a
+broken fixture: the wrapper-surface-drift protocol rule must flag it.
+Do not "fix" this file — the live module was fixed instead.
+
+Original module docstring follows.
+
+Instrumented communicators — the collective data path, measured.
 
 Wraps any :class:`~chainermn_tpu.communicators.communicator_base.
 CommunicatorBase` so every collective and object-plane call records
@@ -203,36 +215,28 @@ class InstrumentedCommunicator:
         return self._run_object(
             "recv_obj", lambda: self._comm.recv_obj(source, tag=tag))
 
-    # The wrapper must mirror the FULL wrapped signature, tag included —
-    # gather_telemetry rides tag=TELEMETRY_TAG through gather_obj, and
-    # dropping ``tag=`` here TypeErrored every instrumented telemetry
-    # gather (the wrapper-surface-drift protocol rule now guards this).
-    def bcast_obj(self, obj, root=0, tag=0):
+    def bcast_obj(self, obj, root=0):
         return self._run_object(
-            "bcast_obj",
-            lambda: self._comm.bcast_obj(obj, root=root, tag=tag))
+            "bcast_obj", lambda: self._comm.bcast_obj(obj, root=root))
 
-    def gather_obj(self, obj, root=0, tag=0):
+    def gather_obj(self, obj, root=0):
         return self._run_object(
-            "gather_obj",
-            lambda: self._comm.gather_obj(obj, root=root, tag=tag))
+            "gather_obj", lambda: self._comm.gather_obj(obj, root=root))
 
-    def allgather_obj(self, obj, tag=0):
+    def allgather_obj(self, obj):
         return self._run_object(
-            "allgather_obj", lambda: self._comm.allgather_obj(obj, tag=tag))
+            "allgather_obj", lambda: self._comm.allgather_obj(obj))
 
-    def scatter_obj(self, objs, root=0, tag=0):
+    def scatter_obj(self, objs, root=0):
         return self._run_object(
-            "scatter_obj",
-            lambda: self._comm.scatter_obj(objs, root=root, tag=tag))
+            "scatter_obj", lambda: self._comm.scatter_obj(objs, root=root))
 
-    def allreduce_obj(self, obj, op="sum", tag=0):
+    def allreduce_obj(self, obj, op="sum"):
         return self._run_object(
-            "allreduce_obj",
-            lambda: self._comm.allreduce_obj(obj, op=op, tag=tag))
+            "allreduce_obj", lambda: self._comm.allreduce_obj(obj, op=op))
 
-    def barrier(self, tag=900):
-        return self._run_object("barrier", lambda: self._comm.barrier(tag=tag))
+    def barrier(self):
+        return self._run_object("barrier", lambda: self._comm.barrier())
 
     # ---- sub-communicators stay instrumented -------------------------------
     def split(self, color: int, key: int):

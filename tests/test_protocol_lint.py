@@ -1,0 +1,485 @@
+"""Control-plane protocol verifier tests (``cmn_lint --protocol``).
+
+Four layers, mirroring docs/static_analysis.md's protocol rule catalog:
+
+* the reserved-tag registry in ``runtime/control_plane.py`` — bands are
+  disjoint, round-trip through ``reserved_tag``/``band_of``, and every
+  subsystem's module constant really imports from the registry;
+* the AST protocol model (``analysis/protocol.py``) — call-site
+  extraction, tag resolution, JSON round-trip;
+* one deliberately-broken fixture tree per rule under
+  ``tests/data/protocol_fixtures/`` — each rule fires with its stable ID
+  on its fixture and stays silent on the real tree (the clean sweep);
+* replay — recorded per-rank object-plane sequences projected against
+  the static model (healthy pass, injected desync, straggler,
+  unknown-op), plus the CLI and a 2-process gather_telemetry run through
+  the instrumented wrapper (the regression the wrapper-surface-drift
+  rule was built around).
+"""
+
+import importlib.util
+import inspect
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.analysis import (
+    ProtocolModel,
+    extract_protocol,
+    lint_step,
+    load_events_by_rank,
+    replay_flight,
+)
+from chainermn_tpu.runtime.control_plane import (
+    BARRIER_TAG,
+    RESERVED_TAG_BANDS,
+    TELEMETRY_TAG,
+    band_of,
+    reserved_tag,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "protocol_fixtures")
+
+PROTOCOL_RULES = ["tag-band-collision", "lockstep-divergence",
+                  "unmatched-send-recv", "wrapper-surface-drift"]
+
+
+def _lint(root, rules, **kw):
+    return lint_step(None, protocol_root=root, rules=rules, hlo=False,
+                     raise_on_error=False, name="protocol-test", **kw)
+
+
+@pytest.fixture(scope="module")
+def tree_model():
+    """The protocol model of the installed package, extracted once."""
+    return extract_protocol()
+
+
+# ---------------------------------------------------------------------------
+# reserved-tag registry
+# ---------------------------------------------------------------------------
+
+class TestTagRegistry:
+    def test_bands_are_disjoint(self):
+        bands = list(RESERVED_TAG_BANDS.values())
+        for i, a in enumerate(bands):
+            for b in bands[i + 1:]:
+                assert a.stop <= b.base or b.stop <= a.base, \
+                    f"bands {a.name} and {b.name} overlap"
+
+    def test_reserved_tag_band_of_round_trip(self):
+        for name, band in RESERVED_TAG_BANDS.items():
+            assert reserved_tag(name) == band.base
+            # every tag in the band maps back to it; the edges just
+            # outside do not
+            for tag in {band.base, band.stop - 1,
+                        band.base + band.width // 2}:
+                assert tag in band
+                assert band_of(tag).name == name
+            assert band.base - 1 not in band
+            assert band.stop not in band
+            outside = band_of(band.stop)
+            assert outside is None or outside.name != name
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(KeyError):
+            reserved_tag("no-such-band")
+
+    def test_unreserved_tag_maps_to_none(self):
+        # 5000 sits between the barrier band and the p2p namespaces
+        assert band_of(5000) is None
+
+    def test_module_constants_import_from_registry(self):
+        from chainermn_tpu.functions.point_to_point_communication import (
+            _GRAD_TAG_OFFSET, _META_TAG_OFFSET)
+        from chainermn_tpu.observability.watchdog import FLIGHT_TAG
+
+        assert TELEMETRY_TAG == reserved_tag("telemetry") == 770
+        assert BARRIER_TAG == reserved_tag("barrier") == 900
+        assert FLIGHT_TAG == reserved_tag("flight") == (1 << 28) + 7
+        assert _GRAD_TAG_OFFSET == reserved_tag("p2p_grad") == 1 << 20
+        assert _META_TAG_OFFSET == reserved_tag("p2p_meta") == 1 << 21
+
+    def test_arithmetic_consumer_bands_have_width_two(self):
+        # allgather_obj/allreduce_obj/barrier consume tag AND tag+1, so
+        # every band they ride needs width >= 2
+        for name in ("default", "telemetry", "barrier"):
+            assert RESERVED_TAG_BANDS[name].width >= 2, name
+
+    def test_p2p_namespaces_cover_the_user_tag_space(self):
+        grad = RESERVED_TAG_BANDS["p2p_grad"]
+        meta = RESERVED_TAG_BANDS["p2p_meta"]
+        # user tag t maps to base + t; both namespaces carry the same
+        # user-tag width without colliding
+        assert grad.width == meta.width == 1 << 20
+        assert grad.stop <= meta.base
+
+    def test_band_as_dict_is_json_ready(self):
+        d = RESERVED_TAG_BANDS["telemetry"].as_dict()
+        assert d["name"] == "telemetry" and d["base"] == 770
+        json.dumps(d)  # must serialize as-is (feeds the lint artifact)
+
+
+# ---------------------------------------------------------------------------
+# model extraction
+# ---------------------------------------------------------------------------
+
+class TestExtraction:
+    def test_tree_extracts_clean(self, tree_model):
+        assert tree_model.errors == []
+        assert len(tree_model.sites) > 20
+        # both planes and several subsystems are represented
+        subsystems = {s.subsystem for s in tree_model.sites}
+        assert {"runtime", "observability"} <= subsystems
+        assert any(s.raw for s in tree_model.sites)          # transport
+        assert any(s.collective for s in tree_model.sites)
+
+    def test_gather_telemetry_pinned_to_named_band(self, tree_model):
+        sites = [s for s in tree_model.sites if s.op == "gather_telemetry"]
+        assert sites, "streaming aggregator site not extracted"
+        for s in sites:
+            assert s.tag == {"kind": "const", "value": TELEMETRY_TAG,
+                             "provenance": "named",
+                             "source": "TELEMETRY_TAG"}
+
+    def test_flight_solicitation_rides_reserved_band(self, tree_model):
+        raw = [s for s in tree_model.sites
+               if s.raw and s.tag.get("kind") == "const"]
+        flight = [s for s in raw
+                  if s.tag["value"] == reserved_tag("flight")]
+        assert {"send", "recv"} <= {s.op for s in flight}
+
+    def test_wrapper_class_ops_extracted(self, tree_model):
+        fwd = [c for c in tree_model.class_ops
+               if c.cls == "InstrumentedCommunicator" and c.forwards_to]
+        assert {"bcast_obj", "gather_obj", "allgather_obj", "scatter_obj",
+                "allreduce_obj", "barrier"} <= {c.op for c in fwd}
+        for c in fwd:
+            if c.op != "barrier":
+                assert "tag" in c.params and "tag" in c.forwarded_params
+
+    def test_json_round_trip(self, tree_model):
+        doc = tree_model.to_json()
+        assert doc["schema"] == "protocol_model/v1"
+        back = ProtocolModel.from_json(doc)
+        assert back.to_json() == doc
+        assert len(back.sites) == len(tree_model.sites)
+
+    def test_lint_accepts_model_dict_and_path(self, tree_model):
+        rep = _lint(tree_model.to_json(), PROTOCOL_RULES)
+        assert rep.ok and not rep.skipped
+        rep = _lint(os.path.join(FIXTURES, "unmatched"),
+                    ["unmatched-send-recv"])
+        assert not rep.ok
+
+    def test_rules_skip_without_model(self):
+        rep = lint_step(None, rules=["tag-band-collision"], hlo=False,
+                        raise_on_error=False, name="no-model")
+        assert "tag-band-collision" in rep.skipped
+        assert "protocol_root" in rep.skipped["tag-band-collision"]
+
+
+# ---------------------------------------------------------------------------
+# rules — one broken fixture each, then the clean sweep
+# ---------------------------------------------------------------------------
+
+class TestProtocolRules:
+    def test_lockstep_divergence_fixture(self):
+        rep = _lint(os.path.join(FIXTURES, "lockstep"),
+                    ["lockstep-divergence"])
+        assert not rep.ok
+        msgs = [f.message for f in rep.findings
+                if f.rule == "lockstep-divergence"]
+        assert len(msgs) == 2
+        # the rank-guarded bcast with no collective on the else path...
+        assert any("rank guard" in m and "bcast_obj" in m for m in msgs)
+        # ...and the except-path-only barrier
+        assert any("except path" in m and "barrier" in m for m in msgs)
+
+    def test_unmatched_send_recv_fixture(self):
+        rep = _lint(os.path.join(FIXTURES, "unmatched"),
+                    ["unmatched-send-recv"])
+        flagged = {(f.details["site"]["op"],
+                    f.details["site"]["tag"]["value"])
+                   for f in rep.findings}
+        assert flagged == {("send_obj", 7), ("recv_obj", 9)}
+
+    def test_tag_band_collision_fixture(self):
+        # subsys_a allgathers at 640 (consuming 640 and 641); subsys_b
+        # runs a p2p channel at literal 641 — an arithmetic-neighbor
+        # collision across subsystems
+        rep = _lint(os.path.join(FIXTURES, "tag_collision"),
+                    ["tag-band-collision"])
+        assert not rep.ok
+        for f in rep.findings:
+            assert f.rule == "tag-band-collision"
+            assert "subsys_a" in f.message and "subsys_b" in f.message
+
+    def test_wrapper_surface_drift_fixture(self):
+        # the committed pre-fix InstrumentedCommunicator snapshot: every
+        # object-plane wrapper dropped ``tag=``
+        rep = _lint(os.path.join(FIXTURES, "wrapper_drift"),
+                    ["wrapper-surface-drift"])
+        assert not rep.ok
+        dropped = {(f.details["op"], tuple(f.details["dropped"]))
+                   for f in rep.findings}
+        assert dropped == {(op, ("tag",)) for op in (
+            "bcast_obj", "gather_obj", "allgather_obj", "scatter_obj",
+            "allreduce_obj", "barrier")}
+        for f in rep.findings:
+            assert f.details["cls"] == "InstrumentedCommunicator"
+
+    def test_prefix_fixture_reproduces_the_type_error(self):
+        """The frozen snapshot really has the bug the rule flags: its
+        gather_obj surface cannot take ``tag=`` (the call every
+        instrumented gather_telemetry makes)."""
+        spec = importlib.util.spec_from_file_location(
+            "instrument_prefix",
+            os.path.join(FIXTURES, "wrapper_drift", "instrument_prefix.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        old = inspect.signature(mod.InstrumentedCommunicator.gather_obj)
+        assert "tag" not in old.parameters
+        from chainermn_tpu.observability.instrument import (
+            InstrumentedCommunicator)
+        new = inspect.signature(InstrumentedCommunicator.gather_obj)
+        assert "tag" in new.parameters
+
+    def test_clean_tree_sweep(self, tree_model):
+        """Zero findings, zero skips over the real package — the
+        PROTOCOL_LINT CI leg's contract."""
+        rep = _lint(tree_model, PROTOCOL_RULES)
+        assert rep.ok
+        assert rep.findings == []
+        assert rep.skipped == {}
+
+
+# ---------------------------------------------------------------------------
+# replay — flight dumps projected against the model
+# ---------------------------------------------------------------------------
+
+def _obj_events(ops, open_ops=()):
+    """Flight-recorder-shaped object-plane events: a begin/end pair per
+    completed op, a dangling begin per open op."""
+    seq: dict = {}
+    evs = []
+    for op in ops:
+        seq[op] = seq.get(op, 0) + 1
+        evs.append({"kind": "object_begin", "op": op, "op_seq": seq[op]})
+        evs.append({"kind": "object_end", "op": op, "op_seq": seq[op]})
+    for op in open_ops:
+        seq[op] = seq.get(op, 0) + 1
+        evs.append({"kind": "object_begin", "op": op, "op_seq": seq[op]})
+    return evs
+
+
+class TestReplay:
+    HEALTHY = ["bcast_obj", "allgather_obj", "barrier"]
+
+    def test_healthy_ranks_pass(self, tree_model):
+        events = {0: _obj_events(self.HEALTHY),
+                  1: _obj_events(self.HEALTHY)}
+        assert replay_flight(tree_model, events) == []
+
+    def test_divergent_op_flagged_with_suspects(self, tree_model):
+        events = {0: _obj_events(self.HEALTHY),
+                  1: _obj_events(["bcast_obj", "gather_obj", "barrier"])}
+        found = replay_flight(tree_model, events)
+        assert [v["kind"] for v in found] == ["divergence"]
+        v = found[0]
+        assert v["index"] == 1 and v["ops"] == ["allgather_obj",
+                                                "gather_obj"]
+        # the static model's rank-guarded collectives ride along as
+        # prime suspects (may be empty on a clean tree, but the key is
+        # part of the contract)
+        assert "suspect_sites" in v
+
+    def test_rank_that_stopped_short_flagged(self, tree_model):
+        events = {0: _obj_events(self.HEALTHY),
+                  1: _obj_events(self.HEALTHY[:1])}
+        kinds = {v["kind"] for v in replay_flight(tree_model, events)}
+        assert kinds == {"divergence"}
+
+    def test_straggler_wedged_in_open_span(self, tree_model):
+        events = {0: _obj_events(self.HEALTHY[:1], open_ops=["barrier"]),
+                  1: _obj_events(self.HEALTHY)}
+        found = replay_flight(tree_model, events)
+        kinds = {v["kind"] for v in found}
+        assert "straggler" in kinds
+        strag = next(v for v in found if v["kind"] == "straggler")
+        assert strag["ranks"] == [0] and strag["ops"] == ["barrier"]
+
+    def test_unknown_op_is_info_not_error(self, tree_model):
+        events = {0: _obj_events(["warp_obj"]),
+                  1: _obj_events(["warp_obj"])}
+        rep = _lint(tree_model, ["protocol-replay-desync"],
+                    flight_events=events)
+        assert rep.ok  # info findings don't fail the lint
+        assert [f.severity for f in rep.findings] == ["info", "info"]
+
+    def test_load_events_normalizes_dump_shapes(self):
+        evs = _obj_events(["barrier"])
+        assert load_events_by_rank({0: evs, 1: evs}) == {0: evs, 1: evs}
+        assert load_events_by_rank({"rank": 3, "events": evs}) == {3: evs}
+        assert load_events_by_rank(
+            {0: {"rank": 0, "events": evs}}) == {0: evs}
+        assert load_events_by_rank(evs) == {0: evs}
+
+    def test_recorded_instrumented_run_replays_clean(self, tree_model):
+        """End to end: record a healthy object-plane program through the
+        REAL instrumented wrapper + flight recorder, then replay the
+        capture (duplicated across two ranks — both ran the same
+        program) against the static model."""
+        from chainermn_tpu.observability import flight_recorder as fl
+        from chainermn_tpu.observability.instrument import (
+            InstrumentedCommunicator)
+        from chainermn_tpu.observability.registry import MetricsRegistry
+        from chainermn_tpu.runtime.control_plane import (
+            ControlPlane, SingleProcessControlPlane)
+
+        rec = fl.FlightRecorder(capacity=256)
+        fl.install_flight_recorder(rec)
+        try:
+            icomm = InstrumentedCommunicator(SingleProcessControlPlane(),
+                                             registry=MetricsRegistry())
+            assert icomm.allgather_obj({"r": 0}) == [{"r": 0}]
+            # gather_telemetry THROUGH the wrapper surface: the base
+            # method with the proxy as self routes its
+            # gather_obj(tag=TELEMETRY_TAG) through the instrumented
+            # gather_obj — the exact call that TypeErrored pre-fix
+            assert ControlPlane.gather_telemetry(
+                icomm, {"loss": 1.0}) == [{"loss": 1.0}]
+            icomm.barrier()
+            events = [e for e in rec.snapshot()
+                      if e["kind"].startswith("object_")]
+        finally:
+            fl.reset_flight_recorder()
+        assert events
+        rep = _lint(tree_model, ["protocol-replay-desync"],
+                    flight_events={0: events, 1: list(events)})
+        assert rep.ok and rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI + artifact
+# ---------------------------------------------------------------------------
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cmn_lint.py"),
+         *argv],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"))
+
+
+class TestCli:
+    def test_protocol_sweep_clean_and_artifact(self, tmp_path):
+        out = tmp_path / "PROTOCOL_LINT_test.json"
+        r = _run_cli("--protocol", "--json", "--out", str(out))
+        assert r.returncode == 0, r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["ok"] is True and doc["findings"] == []
+        assert doc["schema"] == "protocol_lint/v1"
+        assert doc["suite"] == "cmn_lint"  # legacy obs_report lane key
+        proto = doc["protocol"]
+        assert proto["n_sites"] > 20 and proto["parse_errors"] == []
+        assert {b["name"] for b in proto["bands"]} == \
+            set(RESERVED_TAG_BANDS)
+        # the written artifact classifies as a first-class ledger schema
+        from chainermn_tpu.observability.ledger import classify_artifact
+        cls = classify_artifact(json.loads(out.read_text()), str(out))
+        assert cls["schema"] == "protocol_lint/v1"
+        assert cls["legacy"] is False
+
+    def test_protocol_exit_code_on_broken_tree(self):
+        r = _run_cli("--protocol", "--protocol-root",
+                     os.path.join(FIXTURES, "lockstep"))
+        assert r.returncode == 1, r.stdout
+        assert "lockstep-divergence" in r.stdout
+
+    def test_committed_clean_sweep_artifact_is_current(self):
+        """PROTOCOL_LINT_r20.json at the repo root is the committed
+        clean-sweep evidence — it must say CLEAN and carry the stamped
+        schema the ledger census checks for."""
+        path = os.path.join(REPO, "PROTOCOL_LINT_r20.json")
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["ok"] is True and doc["findings"] == []
+        assert doc["schema"] == "protocol_lint/v1"
+
+
+# ---------------------------------------------------------------------------
+# 2-process: gather_telemetry through the instrumented wrapper
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["CHAINERMN_TPU_REPO"])
+from chainermn_tpu.runtime.control_plane import (
+    ControlPlane, TELEMETRY_TAG, get_control_plane)
+from chainermn_tpu.observability.instrument import InstrumentedCommunicator
+from chainermn_tpu.observability.registry import MetricsRegistry
+
+cp = get_control_plane()
+reg = MetricsRegistry()
+icomm = InstrumentedCommunicator(cp, registry=reg)
+out = {"rank": cp.rank}
+
+# gather_telemetry THROUGH the wrapper: the base method with the proxy
+# as self routes gather_obj(tag=TELEMETRY_TAG) through the instrumented
+# surface — pre-fix this raised TypeError on every rank
+summary = {"rank": cp.rank, "step": 7}
+out["gathered"] = ControlPlane.gather_telemetry(icomm, summary)
+out["gather_calls"] = reg.get("comm_object_calls").value(
+    op="gather_obj", comm=type(cp).__name__)
+icomm.barrier()
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_two_process_gather_telemetry_through_instrumented_wrapper():
+    """Two REAL controller processes gather telemetry on the reserved
+    band through InstrumentedCommunicator — the exact cross-process path
+    the tag-drop bug broke (ISSUE 20 satellite)."""
+    from chainermn_tpu.utils.proc_world import free_port
+
+    coord = f"127.0.0.1:{free_port()}"
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({
+            "CHAINERMN_TPU_COORDINATOR": coord,
+            "CHAINERMN_TPU_NUM_PROCESSES": "2",
+            "CHAINERMN_TPU_PROCESS_ID": str(r),
+            "CHAINERMN_TPU_REPO": REPO,
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TELEMETRY_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    try:
+        for r, p in enumerate(procs):
+            stdout, stderr = p.communicate(timeout=120)
+            assert p.returncode == 0, \
+                f"rank {r} failed:\n{stderr}\n{stdout}"
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")]
+            assert line, stdout
+            results[r] = json.loads(line[0][len("RESULT "):])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # root got both summaries rank-ordered; the non-root got None back
+    assert results[0]["gathered"] == [{"rank": 0, "step": 7},
+                                      {"rank": 1, "step": 7}]
+    assert results[1]["gathered"] is None
+    # and the call really went through the instrumented surface
+    for r in range(2):
+        assert results[r]["gather_calls"] == 1

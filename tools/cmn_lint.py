@@ -15,6 +15,8 @@ Usage::
     python tools/cmn_lint.py examples/mnist
     python tools/cmn_lint.py examples/mnist --json --flavors xla,flat
     python tools/cmn_lint.py examples/long_context --out lint.json
+    python tools/cmn_lint.py --protocol --out PROTOCOL_LINT_r20.json
+    python tools/cmn_lint.py --protocol --events dumps/  # replay triage
     python tools/cmn_lint.py --list
 
 Rendered JSON feeds ``tools/obs_report.py --lint`` (the findings lane
@@ -73,6 +75,21 @@ def _build_parser() -> argparse.ArgumentParser:
                         "envelopes, modeled link rates that disagree "
                         "with the latest measured rates per device "
                         "kind); combinable with --events")
+    p.add_argument("--protocol", action="store_true",
+                   help="lint the CONTROL PLANE instead of an entry "
+                        "point: build the static protocol model of "
+                        "every host object-plane call site "
+                        "(analysis/protocol.py) and run the protocol "
+                        "rules (tag-band-collision, lockstep-divergence, "
+                        "unmatched-send-recv, wrapper-surface-drift); "
+                        "with --events, additionally replays the "
+                        "recorded per-rank object-plane sequences "
+                        "against the model (protocol-replay-desync) — "
+                        "the elastic_run incident-triage path; emits a "
+                        "protocol_lint/v1 document")
+    p.add_argument("--protocol-root", metavar="PATH", default=None,
+                   help="tree to extract the protocol model from "
+                        "(default: the installed chainermn_tpu package)")
     p.add_argument("--list", action="store_true", dest="list_entries",
                    help="list entry points and rules, then exit")
     return p
@@ -101,21 +118,35 @@ def _load_events(path: str) -> dict:
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
 
-    if args.events or args.artifacts:
+    if args.protocol or args.events or args.artifacts:
         from chainermn_tpu.analysis.lint import lint_step
         if args.rules:
             rules = args.rules.split(",")
         else:
-            rules = ([] if not args.events
-                     else ["overlapping-collectives"]) \
-                + ([] if not args.artifacts else ["artifact-drift"])
+            rules = []
+            if args.protocol:
+                rules += ["tag-band-collision", "lockstep-divergence",
+                          "unmatched-send-recv", "wrapper-surface-drift"]
+                if args.events:
+                    rules += ["protocol-replay-desync"]
+            if args.events:
+                rules += ["overlapping-collectives"]
+            if args.artifacts:
+                rules += ["artifact-drift"]
         entry = ":".join(filter(None, [
+            (f"protocol:{args.protocol_root or 'chainermn_tpu'}"
+             if args.protocol else None),
             f"events:{args.events}" if args.events else None,
             f"artifacts:{args.artifacts}" if args.artifacts else None]))
+        model = None
+        if args.protocol:
+            from chainermn_tpu.analysis.protocol import extract_protocol
+            model = extract_protocol(args.protocol_root)
         rep = lint_step(None,
                         flight_events=(_load_events(args.events)
                                        if args.events else None),
                         artifact_root=args.artifacts,
+                        protocol_root=model,
                         rules=rules, hlo=False, raise_on_error=False,
                         name=entry)
         doc = {
@@ -125,8 +156,27 @@ def main(argv=None) -> int:
             "findings": [f.as_dict() for f in rep.findings],
             "reports": [rep.to_json()],
         }
+        if args.protocol:
+            # summarize the model the rules ran over (full model on
+            # request via analysis.extract_protocol().to_json())
+            from chainermn_tpu.runtime.control_plane import (
+                RESERVED_TAG_BANDS)
+            subsystems: dict = {}
+            for s in model.sites:
+                subsystems[s.subsystem] = subsystems.get(s.subsystem, 0) + 1
+            doc["protocol"] = {
+                "root": model.root,
+                "n_sites": len(model.sites),
+                "n_class_ops": len(model.class_ops),
+                "sites_by_subsystem": subsystems,
+                "bands": [b.as_dict()
+                          for b in RESERVED_TAG_BANDS.values()],
+                "parse_errors": model.errors,
+            }
         from chainermn_tpu.observability.ledger import stamp_envelope
-        stamp_envelope(doc, "cmn_lint/v1")
+        stamp_envelope(doc,
+                       "protocol_lint/v1" if args.protocol
+                       else "cmn_lint/v1")
         if args.out:
             os.makedirs(os.path.dirname(os.path.abspath(args.out)),
                         exist_ok=True)

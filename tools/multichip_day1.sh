@@ -81,6 +81,19 @@ run 0 "$OUT/CMN_LINT_SERVING_$ROUND.json" \
         $PY_TPU tools/cmn_lint.py serving/decode --json \
         --out '$OUT/CMN_LINT_SERVING_$ROUND.json' > /dev/null"
 
+# ---- preflight: control-plane protocol sweep --------------------------
+# The data-plane lint above says nothing about the DCN object plane the
+# hot-swap broadcast / telemetry gathers / supervisor choreography ride.
+# Sweep the static protocol model (tag-band-collision,
+# lockstep-divergence, unmatched-send-recv, wrapper-surface-drift —
+# docs/static_analysis.md) so a rank-guarded bcast_obj or a tag crossing
+# wires fails HERE, not as a watchdog flight dump at step 40k.  Exit is
+# nonzero on any error finding; hardware-free (pure AST).
+run 0 "$OUT/PROTOCOL_LINT_$ROUND.json" \
+    "cmn-lint --protocol: static lockstep/tag-band/wrapper-drift sweep of the host object plane" -- \
+    bash -c "env JAX_PLATFORMS=cpu $PY_TPU tools/cmn_lint.py --protocol \
+        --out '$OUT/PROTOCOL_LINT_$ROUND.json' > /dev/null"
+
 # ---- single-chip steps (run today, re-run on the slice for parity) ----
 
 run 1 "$OUT/TPU_EVIDENCE_$ROUND.json" \

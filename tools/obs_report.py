@@ -965,11 +965,15 @@ def flight_report(dumps: List[dict], max_events: int = 60) -> str:
 # ---------------------------------------------------------------------------
 
 def load_lint_doc(path: str) -> Optional[dict]:
-    """Load a ``tools/cmn_lint.py --out`` findings document.  A directory
-    is globbed for ``CMN_LINT_*.json`` (the multichip_day1.sh artifact
-    name), newest taken."""
+    """Load a ``tools/cmn_lint.py --out`` findings document — the data-
+    plane suite (``cmn_lint/v1``) or the control-plane protocol sweep
+    (``protocol_lint/v1``, from ``--protocol``).  A directory is globbed
+    for ``CMN_LINT_*.json`` / ``PROTOCOL_LINT_*.json`` (the
+    multichip_day1.sh artifact names), newest taken."""
     if os.path.isdir(path):
-        cands = sorted(glob.glob(os.path.join(path, "CMN_LINT_*.json")))
+        cands = sorted(glob.glob(os.path.join(path, "CMN_LINT_*.json"))
+                       + glob.glob(os.path.join(path,
+                                                "PROTOCOL_LINT_*.json")))
         if not cands:
             return None
         path = cands[-1]
@@ -998,13 +1002,46 @@ def lint_section(doc: dict) -> str:
                           for r in (rep.get("skipped") or {})})
         tail = (f"\nrules skipped everywhere: {', '.join(skipped)}"
                 if skipped else "")
-        return head + "\nno findings — every linted schedule proved safe" \
+        out = head + "\nno findings — every linted schedule proved safe" \
             + tail
-    rows = [[f.get("severity", "?"), f.get("rule", "?"),
-             f.get("target", "-"),
-             " ".join(str(f.get("message", "")).split())[:72]]
-            for f in findings]
-    return head + "\n" + _table(["sev", "rule", "target", "finding"], rows)
+    else:
+        rows = [[f.get("severity", "?"), f.get("rule", "?"),
+                 f.get("target", "-"),
+                 " ".join(str(f.get("message", "")).split())[:72]]
+                for f in findings]
+        out = head + "\n" + _table(["sev", "rule", "target", "finding"],
+                                   rows)
+    proto = doc.get("protocol")
+    if proto:
+        out += "\n\n" + protocol_section(proto)
+    return out
+
+
+def protocol_section(proto: dict) -> str:
+    """Control-plane protocol lane (``cmn_lint --protocol``): the static
+    object-plane model the protocol rules swept — call sites per
+    subsystem and the reserved tag bands keeping concurrent protocols
+    apart on a shared DCN wire (docs/observability.md, "Control-plane
+    protocol")."""
+    by_sub = proto.get("sites_by_subsystem") or {}
+    head = (f"control-plane protocol model ({proto.get('n_sites', 0)} "
+            f"call site(s), {proto.get('n_class_ops', 0)} class op "
+            f"def(s), {len(proto.get('parse_errors') or [])} parse "
+            f"error(s))")
+    parts = [head]
+    if by_sub:
+        parts.append(_table(
+            ["subsystem", "object-plane call sites"],
+            [[k, str(v)] for k, v in sorted(by_sub.items())]))
+    bands = proto.get("bands") or []
+    if bands:
+        parts.append(_table(
+            ["band", "base", "width", "owner", "purpose"],
+            [[b.get("name", "?"), str(b.get("base", "?")),
+              str(b.get("width", "?")), b.get("owner", "?"),
+              " ".join(str(b.get("doc", "")).split())[:48]]
+             for b in bands]))
+    return "\n".join(parts)
 
 
 # ---------------------------------------------------------------------------
